@@ -1,0 +1,29 @@
+"""edl_tpu — TPU-native elastic deep learning framework.
+
+A from-scratch JAX/XLA implementation of the capabilities of PaddlePaddle EDL
+(reference: tinyma123/edl v0.3.1): checkpoint-based elastic collective training
+over TPU device meshes, and elastic knowledge distillation with a
+service-discovery/balancer layer.
+
+Layer map (ours; cf. reference SURVEY.md §1):
+
+    coord/       key/lease/watch coordination store + service registry
+                 (capability of reference discovery/etcd_client.py,
+                 pkg/master/etcd_client.go — native C++ server in native/)
+    collective/  elastic job orchestration: pod rank claim, watcher, barrier,
+                 trainer process management, JobServer/JobClient
+                 (reference collective/launch.py + absent demo pkg)
+    train/       train loop, checkpoint/resume, LR schedules
+                 (reference train_with_fleet.py + fleet save/load_check_point)
+    parallel/    mesh building, sharding rules, ring-attention SP
+                 (reference: NCCL data plane -> XLA collectives over ICI)
+    distill/     DistillReader + teacher discovery/balancing + TPU teacher server
+                 (reference distill/, discovery/)
+    master/      elastic data-sharding task dispenser
+                 (reference pkg/master/service.go intent)
+    models/      ResNet50[_vd], VGG, BOW, DeepFM, transformer — flax
+    data/        sharded input pipelines, seed-per-pass shuffle
+    ops/         pallas TPU kernels
+"""
+
+__version__ = "0.1.0"

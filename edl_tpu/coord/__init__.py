@@ -1,0 +1,25 @@
+from edl_tpu.coord.store import InMemStore, Record, Event, Store
+from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.registry import ServiceRegistry, ServerMeta
+from edl_tpu.coord.consistent_hash import ConsistentHash
+
+
+def __getattr__(name):
+    # Lazy so `python -m edl_tpu.coord.server` doesn't import the module
+    # twice (runpy RuntimeWarning).
+    if name == "StoreServer":
+        from edl_tpu.coord.server import StoreServer
+        return StoreServer
+    raise AttributeError(name)
+
+__all__ = [
+    "Store",
+    "InMemStore",
+    "Record",
+    "Event",
+    "StoreClient",
+    "StoreServer",
+    "ServiceRegistry",
+    "ServerMeta",
+    "ConsistentHash",
+]

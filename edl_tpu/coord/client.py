@@ -1,0 +1,186 @@
+"""Blocking store client speaking the framed-JSON protocol.
+
+Implements the same ``Store`` API as ``InMemStore`` so registry/launcher code
+is backend-agnostic (in-process for tests, TCP for real jobs — the pattern the
+reference gets from swapping etcd/in-mem stores, pkg/master/inmem_store.go).
+
+Reconnect-on-error with bounded retries mirrors the reference's etcd wrapper
+decorator (discovery/etcd_client.py:40-49).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from edl_tpu.coord import wire
+from edl_tpu.coord.store import Event, Record, Store
+from edl_tpu.utils.exceptions import EdlStoreError
+from edl_tpu.utils.logging import get_logger
+from edl_tpu.utils.net import split_endpoint
+
+log = get_logger("edl_tpu.coord.client")
+
+
+class StoreClient(Store):
+    def __init__(self, endpoint: str, timeout: float = 5.0,
+                 connect_retries: int = 30, retry_interval: float = 0.3):
+        self._endpoint = endpoint
+        self._timeout = timeout
+        self._connect_retries = connect_retries
+        self._retry_interval = retry_interval
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+
+    # -- connection management --------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        host, port = split_endpoint(self._endpoint)
+        last: Exception | None = None
+        for _ in range(self._connect_retries):
+            try:
+                sock = socket.create_connection((host, port), timeout=self._timeout)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return sock
+            except OSError as exc:
+                last = exc
+                time.sleep(self._retry_interval)
+        raise EdlStoreError(f"cannot connect to store at {self._endpoint}: {last}")
+
+    # Ops safe to re-send after a connection error. Mutating-but-idempotent
+    # ops (put/delete) are included: re-applying them yields the same state.
+    # put_if_absent / cas are NOT: the first send may have been applied with
+    # the response lost, and a blind resend would report the wrong outcome
+    # (e.g. a rank claim that succeeded looking lost). Those surface an
+    # EdlStoreError and the caller decides (e.g. read back ownership).
+    _RETRYABLE = frozenset({
+        "get", "get_prefix", "events_since", "ping", "lease_keepalive",
+        "put", "delete", "delete_prefix", "lease_revoke", "lease_grant",
+    })
+
+    def _call(self, **req) -> dict:
+        retryable = req.get("op") in self._RETRYABLE
+        with self._lock:
+            attempts = 2 if retryable else 1
+            for attempt in range(1, attempts + 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    wire.send_msg(self._sock, req)
+                    resp = wire.recv_msg(self._sock)
+                    break
+                except (OSError, wire.WireError) as exc:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                    if attempt == attempts:
+                        raise EdlStoreError(
+                            f"store rpc {req.get('op')} failed: {exc}") from exc
+            if not resp.get("ok"):
+                raise EdlStoreError(resp.get("error", "unknown store error"))
+            return resp
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    # -- Store API ---------------------------------------------------------
+
+    def put(self, key: str, value: str, lease: int = 0) -> int:
+        return self._call(op="put", key=key, value=value, lease=lease)["revision"]
+
+    def get(self, key: str) -> Record | None:
+        rec = self._call(op="get", key=key)["record"]
+        return None if rec is None else Record(*rec)
+
+    def get_prefix(self, prefix: str) -> tuple[list[Record], int]:
+        resp = self._call(op="get_prefix", prefix=prefix)
+        return [Record(*r) for r in resp["records"]], resp["revision"]
+
+    def delete(self, key: str) -> bool:
+        return self._call(op="delete", key=key)["deleted"]
+
+    def delete_prefix(self, prefix: str) -> int:
+        return self._call(op="delete_prefix", prefix=prefix)["count"]
+
+    def put_if_absent(self, key: str, value: str, lease: int = 0) -> bool:
+        return self._call(op="put_if_absent", key=key, value=value, lease=lease)["won"]
+
+    def compare_and_swap(self, key: str, expect: str | None, value: str,
+                         lease: int = 0) -> bool:
+        return self._call(op="cas", key=key, expect=expect, value=value,
+                          lease=lease)["won"]
+
+    def lease_grant(self, ttl: float) -> int:
+        return self._call(op="lease_grant", ttl=ttl)["lease"]
+
+    def lease_keepalive(self, lease: int) -> bool:
+        return self._call(op="lease_keepalive", lease=lease)["alive"]
+
+    def lease_revoke(self, lease: int) -> bool:
+        return self._call(op="lease_revoke", lease=lease)["revoked"]
+
+    def events_since(self, revision: int, prefix: str = ""
+                     ) -> tuple[list[Event], int, bool]:
+        resp = self._call(op="events_since", revision=revision, prefix=prefix)
+        return ([Event(*e) for e in resp["events"]], resp["revision"],
+                resp["compacted"])
+
+    def ping(self) -> bool:
+        try:
+            self._call(op="ping")
+            return True
+        except EdlStoreError:
+            return False
+
+
+class LeaseKeeper:
+    """Background thread refreshing a lease (reference utils/register.py's
+    1s refresher thread; discovery/register.py:41-77 retry/re-register loop
+    lives in ServiceRegistry on top of this)."""
+
+    def __init__(self, store: Store, lease: int, interval: float,
+                 on_lost=None):
+        self.store = store
+        self.lease = lease
+        self.interval = interval
+        self.on_lost = on_lost
+        self.lost = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"lease-keeper-{lease}")
+
+    def start(self) -> "LeaseKeeper":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                alive = self.store.lease_keepalive(self.lease)
+            except EdlStoreError as exc:
+                log.warning("lease %d keepalive error: %s", self.lease, exc)
+                continue
+            if not alive:
+                log.error("lease %d lost", self.lease)
+                self.lost.set()
+                if self.on_lost is not None:
+                    self.on_lost()
+                return
+
+    def stop(self, revoke: bool = True) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        if revoke and not self.lost.is_set():
+            try:
+                self.store.lease_revoke(self.lease)
+            except EdlStoreError:
+                pass

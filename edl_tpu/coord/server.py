@@ -1,0 +1,146 @@
+"""TCP store server: serves an InMemStore over the framed-JSON protocol.
+
+One thread per connection + a lease-sweeper thread (so TTL expiry generates
+DELETE events even with no traffic). CLI:
+
+    python -m edl_tpu.coord.server --port 2379
+
+Capability parity: stands in for the reference's external etcd dependency
+(docker/Dockerfile:28-30 bakes etcd into the image; our store is part of the
+framework). The C++ daemon in native/store/ is the production flavor; this
+Python server is the dev/test flavor — identical protocol and semantics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import socketserver
+import threading
+
+from edl_tpu.coord import wire
+from edl_tpu.coord.store import InMemStore
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.coord.server")
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        store: InMemStore = self.server.store  # type: ignore[attr-defined]
+        sock: socket.socket = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                req = wire.recv_msg(sock)
+            except (wire.WireError, OSError):
+                return
+            try:
+                resp = self._dispatch(store, req)
+            except Exception as exc:  # surface the error to the client
+                resp = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+            try:
+                wire.send_msg(sock, resp)
+            except OSError:
+                return
+
+    @staticmethod
+    def _dispatch(store: InMemStore, req: dict) -> dict:
+        op = req.get("op")
+        if op == "put":
+            rev = store.put(req["key"], req["value"], req.get("lease", 0))
+            return {"ok": True, "revision": rev}
+        if op == "get":
+            rec = store.get(req["key"])
+            if rec is None:
+                return {"ok": True, "record": None}
+            return {"ok": True, "record": [rec.key, rec.value, rec.revision, rec.lease]}
+        if op == "get_prefix":
+            recs, rev = store.get_prefix(req["prefix"])
+            return {"ok": True, "revision": rev,
+                    "records": [[r.key, r.value, r.revision, r.lease] for r in recs]}
+        if op == "delete":
+            return {"ok": True, "deleted": store.delete(req["key"])}
+        if op == "delete_prefix":
+            return {"ok": True, "count": store.delete_prefix(req["prefix"])}
+        if op == "put_if_absent":
+            won = store.put_if_absent(req["key"], req["value"], req.get("lease", 0))
+            return {"ok": True, "won": won}
+        if op == "cas":
+            won = store.compare_and_swap(
+                req["key"], req.get("expect"), req["value"], req.get("lease", 0))
+            return {"ok": True, "won": won}
+        if op == "lease_grant":
+            return {"ok": True, "lease": store.lease_grant(float(req["ttl"]))}
+        if op == "lease_keepalive":
+            return {"ok": True, "alive": store.lease_keepalive(req["lease"])}
+        if op == "lease_revoke":
+            return {"ok": True, "revoked": store.lease_revoke(req["lease"])}
+        if op == "events_since":
+            evs, rev, compacted = store.events_since(
+                req["revision"], req.get("prefix", ""))
+            return {"ok": True, "revision": rev, "compacted": compacted,
+                    "events": [[e.type, e.key, e.value, e.revision] for e in evs]}
+        if op == "ping":
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+class _ThreadingServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class StoreServer:
+    """In-process handle: start/stop a store server on a port."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 store: InMemStore | None = None, sweep_interval: float = 0.5):
+        self.store = store or InMemStore()
+        self._server = _ThreadingServer((host, port), _Handler)
+        self._server.store = self.store  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._sweep_interval = sweep_interval
+
+    def start(self) -> "StoreServer":
+        t = threading.Thread(target=self._server.serve_forever,
+                             name="edl-store-serve", daemon=True)
+        s = threading.Thread(target=self._sweeper, name="edl-store-sweep",
+                             daemon=True)
+        t.start()
+        s.start()
+        self._threads = [t, s]
+        log.info("store server listening on :%d", self.port)
+        return self
+
+    def _sweeper(self) -> None:
+        while not self._stop.wait(self._sweep_interval):
+            self.store.sweep()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="edl_tpu coordination store")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=2379)
+    parser.add_argument("--sweep_interval", type=float, default=0.5)
+    args = parser.parse_args()
+    server = StoreServer(args.port, args.host, sweep_interval=args.sweep_interval)
+    server.start()
+    threading.Event().wait()  # serve forever
+
+
+if __name__ == "__main__":
+    main()

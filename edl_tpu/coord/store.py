@@ -1,0 +1,263 @@
+"""Coordination store: keys + leases + revisions + event history.
+
+This is the framework's membership/state substrate — the capability of the
+reference's etcd v3 usage (discovery/etcd_client.py:52-253: TTL leases,
+watches, put-if-absent rank claims; pkg/master/etcd_client.go:49-176:
+locks/leader state). Rather than depending on an external etcd binary, the
+store is part of the framework: ``InMemStore`` is the engine, served over TCP
+by ``StoreServer`` (Python) or the C++ ``edl-store`` daemon (native/), and
+used in-process by unit tests.
+
+Semantics:
+
+- Global monotonically increasing **revision**; every mutation gets one.
+- **Leases**: ``lease_grant(ttl)`` returns an id; keys put with a lease are
+  deleted (with DELETE events) when the lease expires; ``lease_keepalive``
+  refreshes the deadline. Expiry is checked lazily on every public call and
+  by the server's sweeper thread.
+- **Events**: bounded history of PUT/DELETE, queryable by
+  ``events_since(revision, prefix)``; if the window was compacted the caller
+  gets ``compacted=True`` and must fall back to a full ``get_prefix``.
+- **CAS**: ``put_if_absent`` is the rank-claim primitive
+  (reference utils/register.py:60-88).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Record:
+    key: str
+    value: str
+    revision: int
+    lease: int = 0
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str  # "PUT" | "DELETE"
+    key: str
+    value: str
+    revision: int
+
+
+@dataclass
+class _Lease:
+    id: int
+    ttl: float
+    deadline: float
+    keys: set[str] = field(default_factory=set)
+
+
+class Store:
+    """Abstract store API (implemented by InMemStore and StoreClient)."""
+
+    def put(self, key: str, value: str, lease: int = 0) -> int:
+        raise NotImplementedError
+
+    def get(self, key: str) -> Record | None:
+        raise NotImplementedError
+
+    def get_prefix(self, prefix: str) -> tuple[list[Record], int]:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix: str) -> int:
+        raise NotImplementedError
+
+    def put_if_absent(self, key: str, value: str, lease: int = 0) -> bool:
+        raise NotImplementedError
+
+    def compare_and_swap(self, key: str, expect: str | None, value: str,
+                         lease: int = 0) -> bool:
+        raise NotImplementedError
+
+    def lease_grant(self, ttl: float) -> int:
+        raise NotImplementedError
+
+    def lease_keepalive(self, lease: int) -> bool:
+        raise NotImplementedError
+
+    def lease_revoke(self, lease: int) -> bool:
+        raise NotImplementedError
+
+    def events_since(self, revision: int, prefix: str = ""
+                     ) -> tuple[list[Event], int, bool]:
+        """Return (events, current_revision, compacted)."""
+        raise NotImplementedError
+
+
+_MAX_EVENTS = 4096
+
+
+class InMemStore(Store):
+    """Single-process store engine. Thread-safe; time injectable for tests."""
+
+    def __init__(self, clock=time.monotonic, max_events: int = _MAX_EVENTS):
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._data: dict[str, Record] = {}
+        self._leases: dict[int, _Lease] = {}
+        self._revision = 0
+        self._next_lease = 1
+        self._events: list[Event] = []
+        self._max_events = max_events
+        self._first_event_rev = 1  # events older than this were compacted
+
+    # -- internals ---------------------------------------------------------
+
+    def _bump(self) -> int:
+        self._revision += 1
+        return self._revision
+
+    def _emit(self, ev: Event) -> None:
+        self._events.append(ev)
+        if len(self._events) > self._max_events:
+            drop = len(self._events) - self._max_events
+            self._first_event_rev = self._events[drop].revision
+            del self._events[:drop]
+
+    def _expire(self) -> None:
+        now = self._clock()
+        dead = [l for l in self._leases.values() if l.deadline <= now]
+        for lease in dead:
+            for key in sorted(lease.keys):
+                rec = self._data.pop(key, None)
+                if rec is not None:
+                    self._emit(Event("DELETE", key, rec.value, self._bump()))
+            del self._leases[lease.id]
+
+    def _check_lease(self, lease: int) -> None:
+        if lease and lease not in self._leases:
+            from edl_tpu.utils.exceptions import EdlLeaseExpired
+            raise EdlLeaseExpired(f"lease {lease} unknown or expired")
+
+    def _detach(self, key: str, rec: Record) -> None:
+        if rec.lease and rec.lease in self._leases:
+            self._leases[rec.lease].keys.discard(key)
+
+    # -- Store API ---------------------------------------------------------
+
+    def put(self, key: str, value: str, lease: int = 0) -> int:
+        with self._lock:
+            self._expire()
+            self._check_lease(lease)
+            old = self._data.get(key)
+            if old is not None:
+                self._detach(key, old)
+            rev = self._bump()
+            self._data[key] = Record(key, value, rev, lease)
+            if lease:
+                self._leases[lease].keys.add(key)
+            self._emit(Event("PUT", key, value, rev))
+            return rev
+
+    def get(self, key: str) -> Record | None:
+        with self._lock:
+            self._expire()
+            return self._data.get(key)
+
+    def get_prefix(self, prefix: str) -> tuple[list[Record], int]:
+        with self._lock:
+            self._expire()
+            recs = sorted(
+                (r for k, r in self._data.items() if k.startswith(prefix)),
+                key=lambda r: r.key,
+            )
+            return recs, self._revision
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            self._expire()
+            rec = self._data.pop(key, None)
+            if rec is None:
+                return False
+            self._detach(key, rec)
+            self._emit(Event("DELETE", key, rec.value, self._bump()))
+            return True
+
+    def delete_prefix(self, prefix: str) -> int:
+        with self._lock:
+            self._expire()
+            keys = [k for k in self._data if k.startswith(prefix)]
+            for k in keys:
+                rec = self._data.pop(k)
+                self._detach(k, rec)
+                self._emit(Event("DELETE", k, rec.value, self._bump()))
+            return len(keys)
+
+    def put_if_absent(self, key: str, value: str, lease: int = 0) -> bool:
+        with self._lock:
+            self._expire()
+            if key in self._data:
+                return False
+            self._check_lease(lease)
+            rev = self._bump()
+            self._data[key] = Record(key, value, rev, lease)
+            if lease:
+                self._leases[lease].keys.add(key)
+            self._emit(Event("PUT", key, value, rev))
+            return True
+
+    def compare_and_swap(self, key: str, expect: str | None, value: str,
+                         lease: int = 0) -> bool:
+        with self._lock:
+            self._expire()
+            cur = self._data.get(key)
+            if expect is None:
+                if cur is not None:
+                    return False
+            elif cur is None or cur.value != expect:
+                return False
+            self.put(key, value, lease)
+            return True
+
+    def lease_grant(self, ttl: float) -> int:
+        with self._lock:
+            self._expire()
+            lease_id = self._next_lease
+            self._next_lease += 1
+            self._leases[lease_id] = _Lease(lease_id, ttl, self._clock() + ttl)
+            return lease_id
+
+    def lease_keepalive(self, lease: int) -> bool:
+        with self._lock:
+            self._expire()
+            entry = self._leases.get(lease)
+            if entry is None:
+                return False
+            entry.deadline = self._clock() + entry.ttl
+            return True
+
+    def lease_revoke(self, lease: int) -> bool:
+        with self._lock:
+            self._expire()
+            entry = self._leases.pop(lease, None)
+            if entry is None:
+                return False
+            for key in sorted(entry.keys):
+                rec = self._data.pop(key, None)
+                if rec is not None:
+                    self._emit(Event("DELETE", key, rec.value, self._bump()))
+            return True
+
+    def events_since(self, revision: int, prefix: str = ""
+                     ) -> tuple[list[Event], int, bool]:
+        with self._lock:
+            self._expire()
+            if revision + 1 < self._first_event_rev:
+                return [], self._revision, True
+            evs = [e for e in self._events
+                   if e.revision > revision and e.key.startswith(prefix)]
+            return evs, self._revision, False
+
+    def sweep(self) -> None:
+        """Expire due leases now (called by the server's sweeper thread)."""
+        with self._lock:
+            self._expire()

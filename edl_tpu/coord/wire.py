@@ -1,0 +1,55 @@
+"""Framed-JSON wire protocol shared by the store server/client.
+
+Frame = 4-byte magic ``EDL1`` + uint32 big-endian body length + UTF-8 JSON
+body. Requests are ``{"op": str, ...args}``; responses are
+``{"ok": true, ...}`` or ``{"ok": false, "error": str}``. The C++
+``edl-store`` daemon (native/store/) speaks the same frames, so the Python
+client works against either server.
+
+(The reference's redis balancer path uses an analogous hand-rolled framed
+protocol: distill/redis/balance_server.py:27-32. Ours differs in magic,
+framing and message schema by design.)
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any
+
+MAGIC = b"EDL1"
+_HEADER = struct.Struct(">4sI")
+MAX_BODY = 64 * 1024 * 1024
+
+
+class WireError(ConnectionError):
+    pass
+
+
+def send_msg(sock: socket.socket, msg: dict[str, Any]) -> None:
+    body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    sock.sendall(_HEADER.pack(MAGIC, len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> dict[str, Any]:
+    magic, length = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if length > MAX_BODY:
+        raise WireError(f"frame too large: {length}")
+    body = _recv_exact(sock, length)
+    try:
+        return json.loads(body)
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise WireError(f"malformed frame body: {exc}") from exc

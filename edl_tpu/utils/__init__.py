@@ -1,0 +1,21 @@
+from edl_tpu.utils.logging import get_logger
+from edl_tpu.utils.net import free_port, host_ip, is_endpoint_alive
+from edl_tpu.utils.exceptions import (
+    EdlError,
+    EdlBarrierError,
+    EdlRankError,
+    EdlRegisterError,
+    EdlStoreError,
+)
+
+__all__ = [
+    "get_logger",
+    "free_port",
+    "host_ip",
+    "is_endpoint_alive",
+    "EdlError",
+    "EdlBarrierError",
+    "EdlRankError",
+    "EdlRegisterError",
+    "EdlStoreError",
+]

@@ -1,0 +1,68 @@
+"""Typed config with env-var overlay.
+
+The reference's config story is "CLI flag else env var" with a PADDLE_* env
+contract parsed ad-hoc in every entrypoint (reference utils/edl_env.py:86-126,
+collective/launch.py:47-108). Here the same layering is a single reusable
+mechanism: dataclass fields declare an ``env`` name in metadata; ``from_env``
+builds the config as defaults < env < explicit kwargs, with values parsed by
+the field's declared type.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import types
+import typing
+from typing import Any, TypeVar
+
+T = TypeVar("T")
+
+
+def field(default: Any = dataclasses.MISSING, *, env: str | None = None, **kw):
+    """Dataclass field that can be overridden by the env var ``env``."""
+    metadata = dict(kw.pop("metadata", {}))
+    if env is not None:
+        metadata["env"] = env
+    if default is not dataclasses.MISSING and not kw.get("default_factory"):
+        kw["default"] = default
+    return dataclasses.field(metadata=metadata, **kw)
+
+
+def _parse(value: str, typ: Any) -> Any:
+    origin = typing.get_origin(typ)
+    if origin is typing.Union or origin is types.UnionType:  # Optional[X] / X | None
+        args = [a for a in typing.get_args(typ) if a is not type(None)]
+        if not value:
+            return None
+        return _parse(value, args[0])
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ in (int, float, str):
+        return typ(value)
+    if origin in (list, tuple):
+        (elem,) = typing.get_args(typ)[:1] or (str,)
+        items = [_parse(v.strip(), elem) for v in value.split(",") if v.strip()]
+        return tuple(items) if origin is tuple else items
+    return value
+
+
+def from_env(cls: type[T], **overrides: Any) -> T:
+    """Build ``cls`` with env-var overlay: defaults < env < overrides."""
+    hints = typing.get_type_hints(cls)
+    kwargs: dict[str, Any] = {}
+    for f in dataclasses.fields(cls):
+        env_name = f.metadata.get("env")
+        if env_name and env_name in os.environ:
+            kwargs[f.name] = _parse(os.environ[env_name], hints.get(f.name, str))
+    kwargs.update(overrides)
+    return cls(**kwargs)
+
+
+def describe(cfg: Any) -> str:
+    """Pretty one-per-line dump (reference train_with_fleet.py print_arguments)."""
+    lines = [f"----------- {type(cfg).__name__} -----------"]
+    for f in dataclasses.fields(cfg):
+        lines.append(f"{f.name}: {getattr(cfg, f.name)}")
+    lines.append("------------------------------------------")
+    return "\n".join(lines)

@@ -1,0 +1,31 @@
+"""Uniform logger factory.
+
+Capability parity: reference utils/utils.py:25-35 (logger with uniform format,
+per-component names) and per-trainer log redirection (utils/edl_process.py:70-73,
+handled in collective/process.py here).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s [%(process)d] %(message)s"
+
+_configured: set[str] = set()
+
+
+def get_logger(name: str, level: int | str | None = None) -> logging.Logger:
+    """Return a logger with the framework-wide format, configured once."""
+    logger = logging.getLogger(name)
+    if name not in _configured:
+        _configured.add(name)
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        logger.addHandler(handler)
+        logger.propagate = False
+        if level is None:
+            level = os.environ.get("EDL_TPU_LOG_LEVEL", "INFO")
+        logger.setLevel(level)
+    return logger

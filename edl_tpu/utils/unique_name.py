@@ -1,0 +1,28 @@
+"""Process-unique name generation (reference utils/unique_name.py:16)."""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+_counters: dict[str, itertools.count] = {}
+_lock = threading.Lock()
+
+
+def generate(prefix: str) -> str:
+    """Return ``prefix_N`` with a per-prefix monotonically increasing N."""
+    with _lock:
+        counter = _counters.setdefault(prefix, itertools.count())
+        return f"{prefix}_{next(counter)}"
+
+
+def client_id(channel: int = 0) -> str:
+    """Globally-unique-ish client identity: ip-pid-channel-timestamp.
+
+    Capability parity: reference distill/discovery_client.py:169-175.
+    """
+    from edl_tpu.utils.net import host_ip
+
+    return f"{host_ip()}-{os.getpid()}-{channel}-{time.monotonic_ns()}"

@@ -1,0 +1,16 @@
+"""Test-wide environment: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective code is
+validated on host-platform virtual devices (the analogue of the reference's
+fake-backend trick — distill_worker.py:34-42 `_NOP_PREDICT_TEST` — which runs
+the whole multiprocess pipeline with zero network/GPUs).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

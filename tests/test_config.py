@@ -1,0 +1,43 @@
+"""Typed config env overlay."""
+
+import dataclasses
+
+from edl_tpu.utils.config import describe, field, from_env
+
+
+@dataclasses.dataclass
+class Cfg:
+    name: str = field("job", env="T_NAME")
+    nproc: int = field(1, env="T_NPROC")
+    lr: float = field(0.1, env="T_LR")
+    debug: bool = field(False, env="T_DEBUG")
+    port: int | None = field(None, env="T_PORT")
+    hosts: list[str] = field(env="T_HOSTS", default_factory=list)
+
+
+def test_defaults():
+    cfg = from_env(Cfg)
+    assert cfg == Cfg()
+
+
+def test_env_overlay(monkeypatch):
+    monkeypatch.setenv("T_NPROC", "8")
+    monkeypatch.setenv("T_DEBUG", "true")
+    monkeypatch.setenv("T_PORT", "2379")
+    monkeypatch.setenv("T_HOSTS", "a:1, b:2")
+    cfg = from_env(Cfg)
+    assert cfg.nproc == 8
+    assert cfg.debug is True
+    assert cfg.port == 2379 and isinstance(cfg.port, int)  # PEP 604 Optional
+    assert cfg.hosts == ["a:1", "b:2"]
+
+
+def test_overrides_beat_env(monkeypatch):
+    monkeypatch.setenv("T_LR", "0.5")
+    cfg = from_env(Cfg, lr=0.9)
+    assert cfg.lr == 0.9
+
+
+def test_describe():
+    out = describe(Cfg())
+    assert "nproc: 1" in out and "Cfg" in out

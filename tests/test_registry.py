@@ -1,0 +1,113 @@
+"""Service registry: register/expiry/watch over both backends."""
+
+import threading
+import time
+
+import pytest
+
+from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.registry import ServiceRegistry
+from edl_tpu.coord.server import StoreServer
+from edl_tpu.coord.store import InMemStore
+
+
+def test_register_and_read_inmem():
+    reg = ServiceRegistry(InMemStore(), root="test")
+    reg.register_permanent("teachers", "1.2.3.4:9000", info="{gpu:20%}")
+    metas = reg.get_service("teachers")
+    assert len(metas) == 1
+    assert metas[0].server == "1.2.3.4:9000"
+    assert metas[0].info == "{gpu:20%}"
+
+
+def test_ephemeral_registration_lifecycle():
+    with StoreServer(port=0, host="127.0.0.1", sweep_interval=0.05) as srv:
+        client = StoreClient(f"127.0.0.1:{srv.port}")
+        reg = ServiceRegistry(client, root="job0")
+        r = reg.register("teachers", "127.0.0.1:9000", ttl=0.3)
+        time.sleep(0.8)  # several TTLs: keeper must hold it alive
+        assert [m.server for m in reg.get_service("teachers")] == ["127.0.0.1:9000"]
+        r.stop()
+        time.sleep(0.1)
+        assert reg.get_service("teachers") == []
+        client.close()
+
+
+def test_double_register_rejected():
+    from edl_tpu.utils.exceptions import EdlRegisterError
+
+    reg = ServiceRegistry(InMemStore(), root="t")
+    r = reg.register("svc", "h:1", ttl=10)
+    with pytest.raises(EdlRegisterError):
+        reg.register("svc", "h:1", ttl=10)
+    r.stop()
+
+
+def test_watch_add_remove():
+    store = InMemStore()
+    reg = ServiceRegistry(store, root="t")
+    added, removed = [], []
+    ev = threading.Event()
+    watcher = reg.watch_service(
+        "svc",
+        on_add=lambda m: added.append(m.server),
+        on_remove=lambda m: (removed.append(m.server), ev.set()),
+        interval=0.05,
+    )
+    reg.register_permanent("svc", "a:1")
+    reg.register_permanent("svc", "b:2")
+    time.sleep(0.3)
+    assert sorted(added) == ["a:1", "b:2"]
+    reg.deregister("svc", "a:1")
+    assert ev.wait(2.0)
+    assert removed == ["a:1"]
+    assert [m.server for m in watcher.servers()] == ["b:2"]
+    watcher.stop()
+
+
+def test_update_info():
+    store = InMemStore()
+    reg = ServiceRegistry(store, root="t")
+    r = reg.register("svc", "h:1", info="load=0", ttl=10)
+    r.update_info("load=9")
+    assert reg.get_service("svc")[0].info == "load=9"
+    r.stop()
+
+
+def test_reregister_does_not_steal_replacement(monkeypatch):
+    """After lease loss, a zombie Registration must not reclaim a key that a
+    replacement process re-registered for the same server identity."""
+    store = InMemStore()
+    reg = ServiceRegistry(store, root="t")
+    old = reg.register("svc", "h:1", ttl=10)
+    # Simulate the zombie's lease expiring server-side.
+    store.lease_revoke(old._keeper.lease)
+    # Replacement claims the same identity.
+    new = reg.register("svc", "h:1", ttl=10)
+    # Zombie notices and tries to re-register: must fail, not steal.
+    old._on_lost = lambda: None  # silence keeper callback
+    import pytest as _pytest
+    from edl_tpu.utils.exceptions import EdlRegisterError as _ERE
+    with old._lock:
+        with _pytest.raises(_ERE):
+            old._register(initial=False)
+    # Replacement's registration still intact and on a live lease.
+    assert len(reg.get_service("svc")) == 1
+    assert store.lease_keepalive(new._keeper.lease)
+    new.stop()
+
+
+def test_watch_on_update():
+    store = InMemStore()
+    reg = ServiceRegistry(store, root="t")
+    updates = []
+    ev = threading.Event()
+    r = reg.register("svc", "h:1", info="load=0", ttl=10)
+    w = reg.watch_service("svc", on_update=lambda m: (updates.append(m.info), ev.set()),
+                          interval=0.05)
+    r.update_info("load=9")
+    assert ev.wait(2.0)
+    assert updates[-1] == "load=9"
+    assert w.servers()[0].info == "load=9"
+    w.stop()
+    r.stop()

@@ -1,0 +1,146 @@
+"""InMemStore semantics: puts/gets, CAS, leases, expiry, events.
+
+Test model: reference etcd_client_test.py (register/refresh/expiry — "key
+must not alive when expired", watch events, lease keepalive, permanent keys).
+"""
+
+import pytest
+
+from edl_tpu.coord.store import InMemStore
+from edl_tpu.utils.exceptions import EdlLeaseExpired
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def store(clock):
+    return InMemStore(clock=clock)
+
+
+def test_put_get_delete(store):
+    rev1 = store.put("/a/x", "1")
+    rev2 = store.put("/a/y", "2")
+    assert rev2 == rev1 + 1
+    assert store.get("/a/x").value == "1"
+    assert store.get("/missing") is None
+    recs, rev = store.get_prefix("/a/")
+    assert [r.key for r in recs] == ["/a/x", "/a/y"]
+    assert rev == rev2
+    assert store.delete("/a/x")
+    assert not store.delete("/a/x")
+    assert store.get("/a/x") is None
+
+
+def test_put_overwrites_and_bumps_revision(store):
+    r1 = store.put("/k", "v1")
+    r2 = store.put("/k", "v2")
+    assert r2 > r1
+    assert store.get("/k").value == "v2"
+
+
+def test_put_if_absent_races(store):
+    assert store.put_if_absent("/rank/0", "pod-a")
+    assert not store.put_if_absent("/rank/0", "pod-b")
+    assert store.get("/rank/0").value == "pod-a"
+
+
+def test_compare_and_swap(store):
+    assert store.compare_and_swap("/k", None, "v1")
+    assert not store.compare_and_swap("/k", None, "again")
+    assert store.compare_and_swap("/k", "v1", "v2")
+    assert not store.compare_and_swap("/k", "v1", "v3")
+    assert store.get("/k").value == "v2"
+
+
+def test_lease_expiry_deletes_keys(store, clock):
+    lease = store.lease_grant(ttl=10.0)
+    store.put("/svc/nodes/a", "meta", lease=lease)
+    clock.advance(9.0)
+    assert store.get("/svc/nodes/a") is not None
+    clock.advance(2.0)
+    # key must not be alive after the lease expired
+    assert store.get("/svc/nodes/a") is None
+    with pytest.raises(EdlLeaseExpired):
+        store.put("/svc/nodes/b", "x", lease=lease)
+
+
+def test_lease_keepalive_extends(store, clock):
+    lease = store.lease_grant(ttl=10.0)
+    store.put("/k", "v", lease=lease)
+    for _ in range(5):
+        clock.advance(8.0)
+        assert store.lease_keepalive(lease)
+    assert store.get("/k") is not None
+    clock.advance(11.0)
+    assert not store.lease_keepalive(lease)
+    assert store.get("/k") is None
+
+
+def test_lease_revoke(store):
+    lease = store.lease_grant(ttl=100.0)
+    store.put("/k", "v", lease=lease)
+    assert store.lease_revoke(lease)
+    assert store.get("/k") is None
+    assert not store.lease_revoke(lease)
+
+
+def test_permanent_key_outlives_leases(store, clock):
+    store.put("/perm", "v")
+    lease = store.lease_grant(ttl=1.0)
+    store.put("/eph", "v", lease=lease)
+    clock.advance(100.0)
+    assert store.get("/perm") is not None
+    assert store.get("/eph") is None
+
+
+def test_overwrite_detaches_old_lease(store, clock):
+    lease = store.lease_grant(ttl=5.0)
+    store.put("/k", "v1", lease=lease)
+    store.put("/k", "v2")  # now permanent
+    clock.advance(10.0)
+    assert store.get("/k").value == "v2"
+
+
+def test_events_since(store, clock):
+    r0 = store.put("/a", "1")
+    store.put("/b", "2")
+    store.delete("/a")
+    evs, rev, compacted = store.events_since(r0)
+    assert not compacted
+    assert [(e.type, e.key) for e in evs] == [("PUT", "/b"), ("DELETE", "/a")]
+    # prefix filter
+    evs, _, _ = store.events_since(0, prefix="/a")
+    assert [(e.type, e.key) for e in evs] == [("PUT", "/a"), ("DELETE", "/a")]
+    # lease expiry shows up as DELETE events
+    lease = store.lease_grant(ttl=1.0)
+    store.put("/c", "3", lease=lease)
+    clock.advance(2.0)
+    evs, _, _ = store.events_since(rev)
+    types = [(e.type, e.key) for e in evs]
+    assert ("PUT", "/c") in types and ("DELETE", "/c") in types
+
+
+def test_event_compaction(clock):
+    store = InMemStore(clock=clock, max_events=4)
+    for i in range(10):
+        store.put(f"/k{i}", str(i))
+    evs, rev, compacted = store.events_since(0)
+    assert compacted
+    # a recent revision still works
+    evs, _, compacted = store.events_since(rev - 2)
+    assert not compacted
+    assert len(evs) == 2

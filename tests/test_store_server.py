@@ -1,0 +1,106 @@
+"""TCP store server/client integration: same semantics over the wire.
+
+Test model: reference test_etcd_client.sh boots a real etcd then runs
+etcd_client_test.py against it; here the server is in-process but the client
+goes through real sockets and the framed protocol.
+"""
+
+import threading
+
+import pytest
+
+from edl_tpu.coord.client import LeaseKeeper, StoreClient
+from edl_tpu.coord.server import StoreServer
+from edl_tpu.utils.exceptions import EdlStoreError
+
+
+@pytest.fixture
+def server():
+    with StoreServer(port=0, host="127.0.0.1", sweep_interval=0.05) as srv:
+        yield srv
+
+
+@pytest.fixture
+def client(server):
+    c = StoreClient(f"127.0.0.1:{server.port}", timeout=5.0)
+    yield c
+    c.close()
+
+
+def test_roundtrip(client):
+    rev = client.put("/a", "1")
+    assert client.get("/a").value == "1"
+    assert client.get("/a").revision == rev
+    recs, _ = client.get_prefix("/")
+    assert [r.key for r in recs] == ["/a"]
+    assert client.delete("/a")
+    assert client.get("/a") is None
+
+
+def test_cas_over_wire(client):
+    assert client.put_if_absent("/rank/0", "me")
+    assert not client.put_if_absent("/rank/0", "you")
+    assert client.compare_and_swap("/rank/0", "me", "me2")
+    assert not client.compare_and_swap("/rank/0", "nope", "x")
+
+
+def test_lease_expiry_over_wire(server, client):
+    lease = client.lease_grant(ttl=0.2)
+    client.put("/eph", "v", lease=lease)
+    assert client.get("/eph") is not None
+    # sweeper expires it without further traffic
+    deadline = threading.Event()
+    deadline.wait(0.6)
+    assert client.get("/eph") is None
+    assert not client.lease_keepalive(lease)
+
+
+def test_lease_keeper_keeps_alive(server, client):
+    lease = client.lease_grant(ttl=0.3)
+    client.put("/kept", "v", lease=lease)
+    keeper = LeaseKeeper(client, lease, interval=0.05).start()
+    threading.Event().wait(0.8)
+    assert client.get("/kept") is not None
+    keeper.stop(revoke=True)
+    assert client.get("/kept") is None
+
+
+def test_events_over_wire(client):
+    r0 = client.put("/x", "1")
+    client.put("/y", "2")
+    evs, rev, compacted = client.events_since(r0)
+    assert not compacted
+    assert [(e.type, e.key) for e in evs] == [("PUT", "/y")]
+
+
+def test_error_propagates(client):
+    lease = client.lease_grant(ttl=10.0)
+    client.lease_revoke(lease)
+    with pytest.raises(EdlStoreError):
+        client.put("/k", "v", lease=lease)
+
+
+def test_concurrent_rank_claims(server):
+    """N clients race put_if_absent for ranks; each rank claimed exactly once."""
+    n = 8
+    winners = []
+    lock = threading.Lock()
+
+    def claim(pod_id):
+        c = StoreClient(f"127.0.0.1:{server.port}")
+        got = None
+        for rank in range(n):
+            if c.put_if_absent(f"/job/rank/{rank}", pod_id):
+                got = rank
+                break
+        with lock:
+            winners.append((pod_id, got))
+        c.close()
+
+    threads = [threading.Thread(target=claim, args=(f"pod-{i}",)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ranks = sorted(r for _, r in winners)
+    assert ranks == list(range(n))
