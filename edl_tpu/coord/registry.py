@@ -178,8 +178,12 @@ class ServiceWatcher:
         while not self._stop.wait(self._interval):
             try:
                 self._sync()
-            except EdlStoreError as exc:
-                log.warning("watch %s poll failed: %s", self._service, exc)
+            except Exception as exc:
+                # Never let a poll error or a throwing user callback kill the
+                # watch thread — a silently-dead watcher means a permanently
+                # stale membership view.
+                log.warning("watch %s poll failed: %s: %s", self._service,
+                            type(exc).__name__, exc)
 
     def servers(self) -> list[ServerMeta]:
         return sorted(self._known.values(), key=lambda m: m.server)

@@ -1,0 +1,101 @@
+"""fit_a_line: linear-regression smoke job with checkpoint/resume.
+
+The minimum end-to-end slice (BASELINE config 1; reference
+example/fit_a_line/train_ft.py): a single-process job exercising the whole
+framework path — typed config, mesh, jitted SPMD step, TrainLoop,
+atomic versioned checkpoints, resume.
+
+    python -m edl_tpu.examples.fit_a_line --num_epochs 5 --ckpt_dir /tmp/fal
+
+Re-running with the same --ckpt_dir resumes from the last completed epoch.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from edl_tpu.models.linear import LinearRegression, mse_loss
+from edl_tpu.parallel.mesh import make_mesh
+from edl_tpu.train.loop import LoopConfig, TrainLoop
+from edl_tpu.train.state import TrainState
+from edl_tpu.train.step import make_train_step
+from edl_tpu.utils.config import describe, field, from_env
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.examples.fit_a_line")
+
+NUM_FEATURES = 13  # uci-housing shape
+
+
+@dataclass
+class Config:
+    num_epochs: int = field(5, env="EDL_TPU_NUM_EPOCHS")
+    steps_per_epoch: int = 50
+    batch_size: int = 64
+    lr: float = 0.05
+    seed: int = 0
+    ckpt_dir: str | None = field(None, env="EDL_TPU_CHECKPOINT_PATH")
+
+
+def synthetic_batches(epoch: int, cfg: Config):
+    """Deterministic per-epoch data order (seed-per-pass)."""
+    rng = np.random.default_rng(cfg.seed * 1000 + epoch)
+    w = np.arange(1, NUM_FEATURES + 1, dtype=np.float32) / NUM_FEATURES
+    for _ in range(cfg.steps_per_epoch):
+        x = rng.standard_normal((cfg.batch_size, NUM_FEATURES),
+                                dtype=np.float32)
+        y = x @ w[:, None] + 0.5 + 0.01 * rng.standard_normal(
+            (cfg.batch_size, 1), dtype=np.float32)
+        yield {"x": x, "y": y}
+
+
+def build(cfg: Config):
+    model = LinearRegression(features=1)
+    params = model.init(jax.random.key(cfg.seed),
+                        jnp.zeros((1, NUM_FEATURES)))["params"]
+    tx = optax.sgd(cfg.lr)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    def loss_fn(state, params, batch):
+        pred = state.apply_fn({"params": params}, batch["x"])
+        return mse_loss(pred, batch["y"]), {}
+
+    return state, make_train_step(loss_fn)
+
+
+def main(argv: list[str] | None = None) -> float:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_epochs", type=int, default=None)
+    parser.add_argument("--ckpt_dir", default=None)
+    parser.add_argument("--batch_size", type=int, default=None)
+    args = parser.parse_args(argv)
+    overrides = {k: v for k, v in vars(args).items() if v is not None}
+    cfg = from_env(Config, **overrides)
+    log.info("\n%s", describe(cfg))
+
+    mesh = make_mesh()
+    state, step_fn = build(cfg)
+    loop = TrainLoop(
+        step_fn, state, mesh=mesh,
+        config=LoopConfig(num_epochs=cfg.num_epochs, ckpt_dir=cfg.ckpt_dir,
+                          log_every_steps=25),
+    )
+    loop.run(lambda epoch: synthetic_batches(epoch, cfg))
+    if loop.last_metrics:
+        final_loss = float(loop.last_metrics["loss"])
+        log.info("done: epoch=%d step=%d loss=%.5f",
+                 loop.status.epoch, loop.status.step, final_loss)
+        return final_loss
+    log.info("done (nothing to train): epoch=%d step=%d",
+             loop.status.epoch, loop.status.step)
+    return 0.0
+
+
+if __name__ == "__main__":
+    main()

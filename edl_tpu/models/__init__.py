@@ -1,0 +1,14 @@
+from edl_tpu.models.linear import LinearRegression
+
+__all__ = ["LinearRegression"]
+
+
+def __getattr__(name):
+    # Heavier model families load lazily to keep import cost low.
+    if name in ("ResNet", "resnet50", "resnet50_vd", "resnet18", "resnet101"):
+        from edl_tpu.models import resnet
+        return getattr(resnet, name)
+    if name in ("VGG", "vgg16"):
+        from edl_tpu.models import vgg
+        return getattr(vgg, name)
+    raise AttributeError(name)

@@ -1,0 +1,22 @@
+"""Linear regression — the fit_a_line-equivalent smoke model.
+
+Capability parity: reference example/fit_a_line/train_ft.py (uci-housing
+linear regression used as the fault-tolerant smoke job; BASELINE config 1).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LinearRegression(nn.Module):
+    features: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.features)(x)
+
+
+def mse_loss(pred, target):
+    return jnp.mean((pred - target) ** 2)

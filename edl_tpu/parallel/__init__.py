@@ -1,0 +1,15 @@
+from edl_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    data_sharding,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "data_sharding",
+    "replicated",
+    "shard_batch",
+]

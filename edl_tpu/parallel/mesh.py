@@ -1,0 +1,94 @@
+"""Device mesh construction + sharding helpers.
+
+This replaces the reference's process-per-GPU + NCCL world
+(utils/edl_process.py spawns one trainer per GPU; Paddle fleet adds NCCL
+allreduce ops to the graph): here a single process per host lays all local
+(or a prefix of) devices into a named `jax.sharding.Mesh`, and jit-compiled
+step functions get their gradient reductions from XLA's SPMD partitioner
+riding ICI — no collective library, no per-device processes.
+
+Axes (any may be size 1):
+    dp — data parallel (batch dim)
+    fsdp — parameter-sharded data parallel (zero-style)
+    tp — tensor parallel (model dim)
+    sp — sequence/context parallel (ring attention)
+
+Elasticity: a mesh is a pure function of the device list, so an elastic
+resize is just `make_mesh(spec, n_devices=new_n)` after restart — checkpoint
+state re-placed onto the new mesh by the sharding rules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Named logical axes and their sizes. -1 means 'absorb the rest'."""
+
+    axes: dict[str, int] = field(default_factory=lambda: {"dp": -1})
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = dict(self.axes)
+        wild = [k for k, v in sizes.items() if v == -1]
+        fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+        if len(wild) > 1:
+            raise ValueError("at most one axis may be -1")
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[wild[0]] = n_devices // fixed
+        total = int(np.prod(list(sizes.values())))
+        if total != n_devices:
+            raise ValueError(f"mesh {sizes} != {n_devices} devices")
+        return sizes
+
+
+def make_mesh(spec: MeshSpec | None = None, n_devices: int | None = None,
+              devices: list | None = None) -> Mesh:
+    """Build a Mesh over the first n_devices (elastic prefix of the world)."""
+    spec = spec or MeshSpec()
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"want {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    sizes = spec.resolve(len(devices))
+    arr = np.array(devices).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def data_sharding(mesh: Mesh, batch_axes: tuple[str, ...] | None = None
+                  ) -> NamedSharding:
+    """Shard dim 0 (batch) over all data-like axes present in the mesh."""
+    if batch_axes is None:
+        batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    if not batch_axes:
+        return replicated(mesh)
+    return NamedSharding(mesh, P(batch_axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch, batch_axes: tuple[str, ...] | None = None):
+    """Place a host-side batch pytree onto the mesh, sharded along dim 0."""
+    sharding = data_sharding(mesh, batch_axes)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def dp_size(mesh: Mesh) -> int:
+    size = 1
+    for axis in ("dp", "fsdp"):
+        if axis in mesh.axis_names:
+            size *= mesh.shape[axis]
+    return size

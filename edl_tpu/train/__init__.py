@@ -1,0 +1,5 @@
+from edl_tpu.train.state import TrainState, TrainStatus
+from edl_tpu.train.checkpoint import CheckpointManager
+from edl_tpu.train import lr
+
+__all__ = ["TrainState", "TrainStatus", "CheckpointManager", "lr"]

@@ -1,0 +1,127 @@
+"""Versioned atomic checkpoints with resume status.
+
+Contract (capability of the reference's fleet save/load_check_point per
+doc/fault_tolerance.md and train_with_fleet.py:422-434,562-570):
+
+- rank 0 (JAX process 0) writes; all processes load;
+- write to a temp dir then atomic ``os.rename`` to ``ckpt-{version}``;
+- monotonically increasing integer versions; ``latest`` picks the max
+  complete one (a crashed half-written temp dir is never visible);
+- ``TrainStatus`` (epoch/step/world_size) saved in meta.json next to the
+  state so an elastic restart knows where to resume and how the world was
+  shaped at save time;
+- keep the newest ``max_to_keep`` checkpoints.
+
+State payload is a flax-serialized msgpack of the TrainState pytree (fully
+addressable values are gathered to host; on elastic resize the loaded host
+arrays are simply re-placed onto the new mesh — data-parallel state is
+replicated so resharding is trivial; sharded states re-place per the
+sharding rules in parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import tempfile
+from typing import Any
+
+import jax
+from flax import serialization
+
+from edl_tpu.train.state import TrainStatus
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.train.checkpoint")
+
+_CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 process_index: int | None = None):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        self._process_index = process_index
+
+    @property
+    def process_index(self) -> int:
+        if self._process_index is not None:
+            return self._process_index
+        return jax.process_index()
+
+    # -- discovery ---------------------------------------------------------
+
+    def versions(self) -> list[int]:
+        if not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            m = _CKPT_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_version(self) -> int | None:
+        versions = self.versions()
+        return versions[-1] if versions else None
+
+    def _path(self, version: int) -> str:
+        return os.path.join(self.directory, f"ckpt-{version}")
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, state: Any, status: TrainStatus) -> int | None:
+        """Save a new checkpoint; returns its version (None on non-rank-0)."""
+        if self.process_index != 0:
+            return None
+        latest = self.latest_version()
+        version = 0 if latest is None else latest + 1
+        os.makedirs(self.directory, exist_ok=True)
+        host_state = jax.device_get(state)
+        tmp = tempfile.mkdtemp(prefix=".tmp-ckpt-", dir=self.directory)
+        try:
+            with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
+                f.write(serialization.to_bytes(host_state))
+            meta = {"version": version, "status": status.to_dict()}
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f)
+            os.rename(tmp, self._path(version))
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        log.info("saved checkpoint %s (epoch=%d step=%d)",
+                 self._path(version), status.epoch, status.step)
+        self._gc()
+        return version
+
+    def _gc(self) -> None:
+        versions = self.versions()
+        for version in versions[: max(0, len(versions) - self.max_to_keep)]:
+            shutil.rmtree(self._path(version), ignore_errors=True)
+        # clean any orphaned temp dirs from crashed saves
+        for name in os.listdir(self.directory):
+            if name.startswith(".tmp-ckpt-"):
+                path = os.path.join(self.directory, name)
+                shutil.rmtree(path, ignore_errors=True)
+
+    # -- load --------------------------------------------------------------
+
+    def restore(self, target: Any, version: int | None = None
+                ) -> tuple[Any, TrainStatus] | None:
+        """Restore into the structure of ``target``; None if no checkpoint."""
+        if version is None:
+            version = self.latest_version()
+        if version is None:
+            return None
+        path = self._path(version)
+        with open(os.path.join(path, "state.msgpack"), "rb") as f:
+            state = serialization.from_bytes(target, f.read())
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        status = TrainStatus.from_dict(meta["status"])
+        log.info("restored checkpoint %s (epoch=%d step=%d)", path,
+                 status.epoch, status.step)
+        return state, status

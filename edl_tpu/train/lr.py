@@ -1,0 +1,70 @@
+"""Learning-rate schedules.
+
+Feature parity with the reference's schedule set
+(example/collective/resnet50/train_with_fleet.py:114-225): piecewise decay
+with linear warmup, cosine decay [with warmup], exponential decay with
+warmup, plus linear-scaling helpers for elastic batch-size changes
+(doc/edl_collective_design_doc.md:14-16 — LR rescales when the world
+resizes). All schedules are pure functions of the global step, safe inside
+jit (optax-style), built on optax combinators.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def linear_warmup(base_lr: float, warmup_steps: int) -> optax.Schedule:
+    return optax.linear_schedule(0.0, base_lr, max(1, warmup_steps))
+
+
+def piecewise_with_warmup(base_lr: float, boundaries: list[int],
+                          values: list[float], warmup_steps: int = 0
+                          ) -> optax.Schedule:
+    """Step decay: lr = values[i+1] once global step >= boundaries[i];
+    linear warmup over the first warmup_steps. Boundaries are in GLOBAL
+    steps (optax.join_schedules re-bases the inner schedule's step count to
+    the join point, so boundaries are shifted back by warmup_steps here)."""
+    assert len(values) == len(boundaries) + 1
+    assert all(b > warmup_steps for b in boundaries), \
+        "decay boundaries must come after warmup"
+
+    def make_piecewise(offset: int) -> optax.Schedule:
+        return optax.piecewise_constant_schedule(
+            values[0],
+            {b - offset: values[i + 1] / values[i]
+             for i, b in enumerate(boundaries)},
+        )
+
+    if warmup_steps <= 0:
+        return make_piecewise(0)
+    return optax.join_schedules(
+        [linear_warmup(base_lr, warmup_steps), make_piecewise(warmup_steps)],
+        [warmup_steps])
+
+
+def cosine_with_warmup(base_lr: float, total_steps: int,
+                       warmup_steps: int = 0, end_lr: float = 0.0
+                       ) -> optax.Schedule:
+    if warmup_steps <= 0:
+        return optax.cosine_decay_schedule(base_lr, max(1, total_steps),
+                                           alpha=end_lr / max(base_lr, 1e-12))
+    return optax.warmup_cosine_decay_schedule(
+        0.0, base_lr, warmup_steps, max(total_steps, warmup_steps + 1),
+        end_value=end_lr)
+
+
+def exponential_with_warmup(base_lr: float, warmup_steps: int,
+                            decay_steps: int, decay_rate: float,
+                            staircase: bool = True) -> optax.Schedule:
+    decay = optax.exponential_decay(base_lr, decay_steps, decay_rate,
+                                    staircase=staircase)
+    if warmup_steps <= 0:
+        return decay
+    return optax.join_schedules(
+        [linear_warmup(base_lr, warmup_steps), decay], [warmup_steps])
+
+
+def scale_for_world(base_lr: float, base_world: int, world: int) -> float:
+    """Linear-scaling rule on elastic resize: lr ∝ global batch size."""
+    return base_lr * world / max(1, base_world)

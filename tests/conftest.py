@@ -4,13 +4,17 @@ Multi-chip TPU hardware is not available in CI; sharding/collective code is
 validated on host-platform virtual devices (the analogue of the reference's
 fake-backend trick — distill_worker.py:34-42 `_NOP_PREDICT_TEST` — which runs
 the whole multiprocess pipeline with zero network/GPUs).
+
+Env vars are too late here (the interpreter's sitecustomize may already have
+imported jax to register a TPU plugin), so use jax.config directly — it works
+as long as no backend has been initialized yet.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ.setdefault("EDL_TPU_TEST_DEVICES", "8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", int(os.environ["EDL_TPU_TEST_DEVICES"]))

@@ -1,0 +1,73 @@
+"""Checkpoint manager: versioning, atomicity, GC, restore."""
+
+import os
+
+import jax.numpy as jnp
+import optax
+import pytest
+
+from edl_tpu.train.checkpoint import CheckpointManager
+from edl_tpu.train.state import TrainState, TrainStatus
+
+
+def _state(value: float) -> TrainState:
+    params = {"w": jnp.full((4,), value), "b": jnp.zeros((2, 2))}
+    tx = optax.sgd(0.1)
+    return TrainState.create(apply_fn=lambda *a: None, params=params, tx=tx)
+
+
+def test_save_restore_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), process_index=0)
+    state = _state(1.5)
+    v = mgr.save(state, TrainStatus(epoch=3, step=120, world_size=8))
+    assert v == 0
+    restored, status = mgr.restore(_state(0.0))
+    assert float(restored.params["w"][0]) == 1.5
+    assert status.epoch == 3 and status.step == 120 and status.world_size == 8
+
+
+def test_versions_increase_and_gc(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2, process_index=0)
+    for i in range(5):
+        mgr.save(_state(float(i)), TrainStatus(epoch=i))
+    assert mgr.versions() == [3, 4]
+    restored, status = mgr.restore(_state(0.0))
+    assert status.epoch == 4
+    # restore a specific older version
+    restored, status = mgr.restore(_state(0.0), version=3)
+    assert status.epoch == 3
+
+
+def test_no_checkpoint_returns_none(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), process_index=0)
+    assert mgr.restore(_state(0.0)) is None
+    assert mgr.latest_version() is None
+
+
+def test_nonzero_rank_does_not_write(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), process_index=1)
+    assert mgr.save(_state(1.0), TrainStatus(epoch=0)) is None
+    assert mgr.versions() == []
+
+
+def test_crashed_partial_write_invisible(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), process_index=0)
+    mgr.save(_state(1.0), TrainStatus(epoch=0))
+    # simulate a crash mid-save: orphan temp dir with partial contents
+    orphan = tmp_path / ".tmp-ckpt-dead"
+    orphan.mkdir()
+    (orphan / "state.msgpack").write_bytes(b"garbage")
+    restored, status = mgr.restore(_state(0.0))
+    assert status.epoch == 0  # only the complete version is visible
+    mgr.save(_state(2.0), TrainStatus(epoch=1))  # gc cleans the orphan
+    assert not orphan.exists()
+
+
+def test_corrupt_meta_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), process_index=0)
+    mgr.save(_state(1.0), TrainStatus(epoch=0))
+    path = os.path.join(str(tmp_path), "ckpt-0", "meta.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    with pytest.raises(Exception):
+        mgr.restore(_state(0.0))

@@ -1,0 +1,49 @@
+"""Mesh construction and batch sharding on the 8-device CPU world."""
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.parallel.mesh import (
+    MeshSpec, data_sharding, dp_size, make_mesh, shard_batch)
+
+
+def test_default_dp_mesh_uses_all_devices():
+    mesh = make_mesh()
+    assert mesh.shape["dp"] == jax.device_count() == 8
+
+
+def test_elastic_prefix():
+    for n in (1, 2, 4, 8):
+        mesh = make_mesh(n_devices=n)
+        assert mesh.shape["dp"] == n
+    with pytest.raises(ValueError):
+        make_mesh(n_devices=9)
+
+
+def test_2d_mesh_resolution():
+    spec = MeshSpec({"dp": -1, "tp": 2})
+    mesh = make_mesh(spec)
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    with pytest.raises(ValueError):
+        make_mesh(MeshSpec({"dp": 3, "tp": 2}))  # 6 != 8
+    with pytest.raises(ValueError):
+        MeshSpec({"dp": -1, "tp": -1}).resolve(8)
+
+
+def test_shard_batch_layout():
+    mesh = make_mesh()
+    batch = {"x": np.zeros((16, 3), np.float32)}
+    placed = shard_batch(mesh, batch)
+    x = placed["x"]
+    assert x.sharding == data_sharding(mesh)
+    # each device holds 16/8 = 2 rows
+    assert x.addressable_shards[0].data.shape == (2, 3)
+    assert dp_size(mesh) == 8
+
+
+def test_fsdp_counts_as_data_axis():
+    mesh = make_mesh(MeshSpec({"dp": 2, "fsdp": 4}))
+    assert dp_size(mesh) == 8
+    batch = shard_batch(mesh, {"x": np.zeros((8, 2), np.float32)})
+    assert batch["x"].addressable_shards[0].data.shape == (1, 2)
