@@ -151,39 +151,49 @@ class ServiceWatcher:
             target=self._run, daemon=True, name=f"watch-{service}")
 
     def start(self) -> "ServiceWatcher":
-        self._sync()
+        # Initial sync is best-effort: a transient store error here must not
+        # leave the caller holding a watcher whose thread never started —
+        # the poll loop will converge on the next tick.
+        self._safe_sync()
         self._thread.start()
         return self
 
     def _sync(self) -> None:
         metas = self._registry.get_service(self._service)
         now = {m.server: m for m in metas}
+        # _known is updated only AFTER a callback succeeds: if a consumer
+        # callback throws (e.g. while splicing a hash ring), the event is
+        # re-delivered on the next poll instead of being lost forever.
         for server in list(self._known):
             if server not in now:
-                meta = self._known.pop(server)
+                meta = self._known[server]
                 if self._on_remove:
                     self._on_remove(meta)
+                self._known.pop(server, None)
         for server, meta in now.items():
             old = self._known.get(server)
             if old is None:
-                self._known[server] = meta
                 if self._on_add:
                     self._on_add(meta)
-            elif old.info != meta.info or old.revision != meta.revision:
                 self._known[server] = meta
+            elif old.info != meta.info or old.revision != meta.revision:
                 if self._on_update:
                     self._on_update(meta)
+                self._known[server] = meta
+
+    def _safe_sync(self) -> None:
+        try:
+            self._sync()
+        except Exception as exc:
+            # Never let a poll error or a throwing user callback kill the
+            # watch thread — a silently-dead watcher means a permanently
+            # stale membership view.
+            log.warning("watch %s poll failed: %s: %s", self._service,
+                        type(exc).__name__, exc)
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
-            try:
-                self._sync()
-            except Exception as exc:
-                # Never let a poll error or a throwing user callback kill the
-                # watch thread — a silently-dead watcher means a permanently
-                # stale membership view.
-                log.warning("watch %s poll failed: %s: %s", self._service,
-                            type(exc).__name__, exc)
+            self._safe_sync()
 
     def servers(self) -> list[ServerMeta]:
         return sorted(self._known.values(), key=lambda m: m.server)

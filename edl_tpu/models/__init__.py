@@ -2,13 +2,25 @@ from edl_tpu.models.linear import LinearRegression
 
 __all__ = ["LinearRegression"]
 
+_LAZY = {
+    "resnet": ("ResNet", "ResNet50", "ResNet101", "ResNet152",
+               "ResNet50_vd", "ResNet101_vd", "ResNet152_vd", "ResNetTiny",
+               "BottleneckBlock"),
+    "vgg": ("VGG", "VGG11", "VGG13", "VGG16", "VGG19"),
+    "transformer": ("Transformer", "TransformerConfig"),
+    "bow": ("BOWClassifier",),
+    "deepfm": ("DeepFM",),
+}
+
 
 def __getattr__(name):
     # Heavier model families load lazily to keep import cost low.
-    if name in ("ResNet", "resnet50", "resnet50_vd", "resnet18", "resnet101"):
-        from edl_tpu.models import resnet
-        return getattr(resnet, name)
-    if name in ("VGG", "vgg16"):
-        from edl_tpu.models import vgg
-        return getattr(vgg, name)
+    for module, names in _LAZY.items():
+        if name in names:
+            import importlib
+            try:
+                mod = importlib.import_module(f"edl_tpu.models.{module}")
+            except ModuleNotFoundError as exc:
+                raise AttributeError(name) from exc
+            return getattr(mod, name)
     raise AttributeError(name)

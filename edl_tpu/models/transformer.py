@@ -1,0 +1,170 @@
+"""Decoder-only transformer LM — the long-context / multi-axis flagship.
+
+Net-new relative to the reference (its models are CNNs + BOW/ERNIE-distill,
+SURVEY.md §5): a causal LM whose parameters carry flax *logical axis names*
+(`vocab/embed/heads/kv/mlp`) so `edl_tpu.parallel.sharding` rules shard them
+over any `dp x fsdp x tp x sp` mesh, and whose attention switches to
+`edl_tpu.parallel.ring_attention` when the mesh has a real `sp` axis —
+sequence/context parallelism with k/v blocks rotating over ICI.
+
+Everything is static-shaped and jit-traceable; remat is applied per block
+(`jax.checkpoint`) to trade FLOPs for HBM when configured.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dfield
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh
+
+from edl_tpu.parallel import ring_attention as ra
+from edl_tpu.parallel import sharding as shd
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_len: int = 2048
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    # mesh: when set (and it has sp>1) attention runs the ring kernel and
+    # activations get logical sharding constraints. None = single-device.
+    mesh: Mesh | None = dfield(default=None, hash=False, compare=False)
+    rules: tuple = shd.DEFAULT_RULES
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    def constrain(self, x, logical):
+        return shd.constrain(x, logical, self.mesh, self.rules)
+
+    @property
+    def use_ring(self) -> bool:
+        return (self.mesh is not None and "sp" in self.mesh.axis_names
+                and self.mesh.shape["sp"] > 1)
+
+
+def _dense(features, names, cfg, name=None):
+    return nn.DenseGeneral(
+        features, axis=-1, dtype=cfg.dtype, name=name, use_bias=False,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal"),
+            names))
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        proj = partial(nn.DenseGeneral, axis=-1, dtype=cfg.dtype,
+                       use_bias=False)
+        qkv_init = nn.with_logical_partitioning(
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal"),
+            ("embed", "heads", "kv"))
+        q = proj((cfg.n_heads, cfg.head_dim), kernel_init=qkv_init,
+                 name="query")(x)
+        k = proj((cfg.n_heads, cfg.head_dim), kernel_init=qkv_init,
+                 name="key")(x)
+        v = proj((cfg.n_heads, cfg.head_dim), kernel_init=qkv_init,
+                 name="value")(x)
+        q = cfg.constrain(q, ("batch", "seq", "heads", "kv"))
+        k = cfg.constrain(k, ("batch", "seq", "heads", "kv"))
+        v = cfg.constrain(v, ("batch", "seq", "heads", "kv"))
+
+        if cfg.use_ring:
+            o = ra.ring_attention(q, k, v, mesh=cfg.mesh, causal=True)
+        else:
+            o = ra.dense_attention(q, k, v, causal=True)
+        o = cfg.constrain(o, ("batch", "seq", "heads", "kv"))
+
+        out_init = nn.with_logical_partitioning(
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal"),
+            ("heads", "kv", "embed"))
+        o = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+                            use_bias=False, kernel_init=out_init,
+                            name="out")(o)
+        return cfg.constrain(o, ("batch", "seq", "embed"))
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_attn")(x)
+        h = Attention(cfg, name="attn")(h, train)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x)
+        h = _dense(cfg.d_ff, ("embed", "mlp"), cfg, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = cfg.constrain(h, ("batch", "seq", "mlp"))
+        h = _dense(cfg.d_model, ("mlp", "embed"), cfg, name="mlp_out")(h)
+        if cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class Transformer(nn.Module):
+    """Causal LM: tokens (B, S) int32 -> logits (B, S, vocab) fp32."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")),
+            name="tok_embed")
+        pos_embed = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         ("seq", "embed")),
+            (cfg.max_len, cfg.d_model))
+        x = embed(tokens)
+        x = x + pos_embed[None, :tokens.shape[1]].astype(cfg.dtype)
+        x = cfg.constrain(x, ("batch", "seq", "embed"))
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"block{i}")(x, train)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_final")(x)
+        # Tied-untied head: separate projection, fp32 logits for stable CE.
+        logits = nn.DenseGeneral(
+            cfg.vocab_size, axis=-1, dtype=jnp.float32, use_bias=False,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.variance_scaling(1.0, "fan_in", "normal"),
+                ("embed", "vocab")),
+            name="lm_head")(x)
+        return logits
+
+
+def lm_loss_fn(state, params, batch):
+    """Causal LM loss for {'tokens': (B,S)} batches (next-token CE)."""
+    logits = state.apply_fn({"params": params}, batch["tokens"], train=True)
+    targets = batch["tokens"][:, 1:]
+    logits = logits[:, :-1]
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = -jnp.mean(ll)
+    return loss, {"ppl": jnp.exp(loss)}

@@ -1,0 +1,52 @@
+"""VGG (11/13/16/19) in flax.
+
+Capability of the reference `example/collective/resnet50/models/vgg.py`
+(conv-block builder with per-stage conv counts + 3 FC layers). NHWC, bf16
+activations, fp32 classifier head — see resnet.py for the layout rationale.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class VGG(nn.Module):
+    stage_convs: Sequence[int]          # convs per stage, 5 stages
+    num_classes: int = 1000
+    fc_dim: int = 4096
+    dropout: float = 0.5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), dtype=self.dtype,
+                       kernel_init=nn.initializers.variance_scaling(
+                           2.0, "fan_out", "normal"))
+        x = x.astype(self.dtype)
+        widths = (64, 128, 256, 512, 512)
+        for stage, (n_convs, width) in enumerate(
+                zip(self.stage_convs, widths)):
+            for i in range(n_convs):
+                x = conv(width, name=f"conv{stage}_{i}")(x)
+                x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 epsilon=1e-5, dtype=self.dtype,
+                                 name=f"norm{stage}_{i}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for i in range(2):
+            x = nn.Dense(self.fc_dim, dtype=self.dtype, name=f"fc{i}")(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+VGG11 = partial(VGG, stage_convs=(1, 1, 2, 2, 2))
+VGG13 = partial(VGG, stage_convs=(2, 2, 2, 2, 2))
+VGG16 = partial(VGG, stage_convs=(2, 2, 3, 3, 3))
+VGG19 = partial(VGG, stage_convs=(2, 2, 4, 4, 4))

@@ -5,6 +5,14 @@ from edl_tpu.parallel.mesh import (
     replicated,
     shard_batch,
 )
+from edl_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    constrain,
+    init_sharded,
+    logical_to_spec,
+    param_shardings,
+)
+from edl_tpu.parallel import ring_attention  # module (fn: ring_attention.ring_attention)
 
 __all__ = [
     "MeshSpec",
@@ -12,4 +20,10 @@ __all__ = [
     "data_sharding",
     "replicated",
     "shard_batch",
+    "DEFAULT_RULES",
+    "constrain",
+    "init_sharded",
+    "logical_to_spec",
+    "param_shardings",
+    "ring_attention",
 ]

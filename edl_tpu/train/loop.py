@@ -68,6 +68,10 @@ class TrainLoop:
                                        self.config.ckpt_max_to_keep)
                      if self.config.ckpt_dir else None)
         self.last_metrics: dict = {}
+        # World size recorded in the restored checkpoint, set by
+        # try_restore(); None until a restore happens. Consumers use it to
+        # rescale LR/batch after an elastic resize (lr.scale_for_world).
+        self.saved_world_size: int | None = None
 
     # -- checkpoint glue ---------------------------------------------------
 
@@ -78,6 +82,9 @@ class TrainLoop:
         if restored is None:
             return False
         self.state, self.status = restored
+        # Preserve the save-time world size (the resharding/LR-rescale hint)
+        # before stamping the current world for the next save.
+        self.saved_world_size = self.status.world_size
         self.status.world_size = (mesh_lib.dp_size(self.mesh)
                                   if self.mesh is not None
                                   else jax.device_count())

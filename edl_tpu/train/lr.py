@@ -18,13 +18,14 @@ def linear_warmup(base_lr: float, warmup_steps: int) -> optax.Schedule:
     return optax.linear_schedule(0.0, base_lr, max(1, warmup_steps))
 
 
-def piecewise_with_warmup(base_lr: float, boundaries: list[int],
-                          values: list[float], warmup_steps: int = 0
-                          ) -> optax.Schedule:
+def piecewise_with_warmup(boundaries: list[int], values: list[float],
+                          warmup_steps: int = 0) -> optax.Schedule:
     """Step decay: lr = values[i+1] once global step >= boundaries[i];
-    linear warmup over the first warmup_steps. Boundaries are in GLOBAL
-    steps (optax.join_schedules re-bases the inner schedule's step count to
-    the join point, so boundaries are shifted back by warmup_steps here)."""
+    linear warmup from 0 to values[0] over the first warmup_steps (so the
+    schedule is continuous at the warmup/decay join). Boundaries are in
+    GLOBAL steps (optax.join_schedules re-bases the inner schedule's step
+    count to the join point, so boundaries are shifted back by warmup_steps
+    here)."""
     assert len(values) == len(boundaries) + 1
     assert all(b > warmup_steps for b in boundaries), \
         "decay boundaries must come after warmup"
@@ -39,7 +40,8 @@ def piecewise_with_warmup(base_lr: float, boundaries: list[int],
     if warmup_steps <= 0:
         return make_piecewise(0)
     return optax.join_schedules(
-        [linear_warmup(base_lr, warmup_steps), make_piecewise(warmup_steps)],
+        [linear_warmup(values[0], warmup_steps),
+         make_piecewise(warmup_steps)],
         [warmup_steps])
 
 

@@ -86,7 +86,7 @@ def test_elastic_world_resize_resume(tmp_path):
 
 
 def test_lr_schedules():
-    sched = lr_lib.piecewise_with_warmup(0.1, [100, 200], [0.1, 0.01, 0.001],
+    sched = lr_lib.piecewise_with_warmup([100, 200], [0.1, 0.01, 0.001],
                                          warmup_steps=10)
     assert float(sched(0)) == pytest.approx(0.0)
     assert float(sched(10)) == pytest.approx(0.1)
@@ -147,7 +147,7 @@ def test_midepoch_checkpoint_resume(tmp_path):
 
 
 def test_piecewise_boundaries_are_global_steps():
-    sched = lr_lib.piecewise_with_warmup(0.1, [100], [0.1, 0.01],
+    sched = lr_lib.piecewise_with_warmup([100], [0.1, 0.01],
                                          warmup_steps=10)
     assert float(sched(99)) == pytest.approx(0.1)
     assert float(sched(101)) == pytest.approx(0.01)  # not shifted to 110
