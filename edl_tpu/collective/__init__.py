@@ -1,0 +1,19 @@
+"""Elastic collective job orchestration.
+
+The capability of the reference's L3 layer (SURVEY.md §1): pod rank claim,
+cluster watcher, stop-resume barrier, trainer process management, and the
+JobServer/JobClient demo pair (ABSENT upstream, re-specified from
+collective/launch.py + example/demo/collective/README.md).
+
+TPU-native shape: one launcher process per TPU host ("pod"); the trainer it
+spawns is a single JAX process driving all local chips, joined into a
+multi-host world via `jax.distributed` + a `jax.sharding.Mesh` — elasticity
+is stop-resume: on membership change every launcher kills its trainer and
+re-forms the cluster; trainers resume from the latest checkpoint on a fresh
+mesh.
+"""
+
+from edl_tpu.collective.cluster import Cluster, Pod
+from edl_tpu.collective.job_env import JobEnv, TrainerEnv
+
+__all__ = ["Cluster", "Pod", "JobEnv", "TrainerEnv"]

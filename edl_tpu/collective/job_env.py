@@ -1,0 +1,108 @@
+"""Job + trainer environment contracts.
+
+The EDL_TPU_* env contract replacing the reference's PADDLE_* one
+(utils/edl_env.py:86-126: JOB_ID, POD_ID, ETCD_ENPOINTS, NODES_RANGE
+"min:max", NPROC_PERNODE, checkpoint/HDFS vars; utils/edl_process.py:51-59:
+per-trainer PADDLE_TRAINER_ID/ENDPOINTS env). `JobEnv` is read by the
+launcher; `TrainerEnv` is what the spawned trainer process reads back.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from edl_tpu.collective.cluster import Cluster
+from edl_tpu.utils.config import field, from_env
+from edl_tpu.utils import net, unique_name
+
+
+@dataclass
+class JobEnv:
+    job_id: str = field("default_job", env="EDL_TPU_JOB_ID")
+    pod_id: str = field("", env="EDL_TPU_POD_ID")
+    store_endpoints: str = field("127.0.0.1:2379",
+                                 env="EDL_TPU_STORE_ENDPOINTS")
+    nodes_range: str = field("1:16", env="EDL_TPU_NODES_RANGE")  # "min:max"
+    nproc_per_node: int = field(0, env="EDL_TPU_NPROC_PERNODE")  # 0 = auto
+    up_limit_nodes: int = field(1024, env="EDL_TPU_UP_LIMIT_NODES")
+    checkpoint_path: str = field("", env="EDL_TPU_CHECKPOINT_PATH")
+    job_server: str = field("", env="EDL_TPU_JOBSERVER")
+    log_dir: str = field("./log", env="EDL_TPU_LOG_DIR")
+    lease_ttl: float = field(10.0, env="EDL_TPU_LEASE_TTL")
+    barrier_stable_secs: float = field(2.0, env="EDL_TPU_BARRIER_STABLE")
+    barrier_timeout: float = field(300.0, env="EDL_TPU_BARRIER_TIMEOUT")
+
+    def __post_init__(self):
+        if not self.pod_id:
+            self.pod_id = unique_name.client_id()
+
+    @property
+    def min_nodes(self) -> int:
+        return int(self.nodes_range.split(":")[0])
+
+    @property
+    def max_nodes(self) -> int:
+        parts = self.nodes_range.split(":")
+        return min(int(parts[-1]), self.up_limit_nodes)
+
+    @classmethod
+    def from_environ(cls, **overrides) -> "JobEnv":
+        return from_env(cls, **overrides)
+
+
+TRAINER_ENV_VARS = ("EDL_TPU_RANK", "EDL_TPU_WORLD_SIZE",
+                    "EDL_TPU_COORDINATOR", "EDL_TPU_CLUSTER_JSON",
+                    "EDL_TPU_JOB_ID", "EDL_TPU_POD_ID",
+                    "EDL_TPU_CHECKPOINT_PATH", "EDL_TPU_STORE_ENDPOINTS",
+                    "EDL_TPU_CLUSTER_VERSION")
+
+
+@dataclass
+class TrainerEnv:
+    """What a spawned trainer process sees (reference TrainerEnv,
+    utils/edl_env.py:149)."""
+
+    rank: int = field(0, env="EDL_TPU_RANK")
+    world_size: int = field(1, env="EDL_TPU_WORLD_SIZE")
+    coordinator: str = field("", env="EDL_TPU_COORDINATOR")
+    cluster_json: str = field("", env="EDL_TPU_CLUSTER_JSON")
+    job_id: str = field("", env="EDL_TPU_JOB_ID")
+    pod_id: str = field("", env="EDL_TPU_POD_ID")
+    checkpoint_path: str = field("", env="EDL_TPU_CHECKPOINT_PATH")
+    store_endpoints: str = field("", env="EDL_TPU_STORE_ENDPOINTS")
+    cluster_version: int = field(0, env="EDL_TPU_CLUSTER_VERSION")
+
+    @classmethod
+    def from_environ(cls, **overrides) -> "TrainerEnv":
+        return from_env(cls, **overrides)
+
+    @property
+    def cluster(self) -> Cluster | None:
+        return Cluster.from_json(self.cluster_json) \
+            if self.cluster_json else None
+
+    @property
+    def is_leader(self) -> bool:
+        return self.rank == 0
+
+
+def trainer_environ(cluster: Cluster, pod_id: str, job: JobEnv) -> dict:
+    """Env block for the trainer subprocess (reference edl_process.py:51-59)."""
+    env = dict(os.environ)
+    env.update({
+        "EDL_TPU_RANK": str(cluster.rank_of(pod_id)),
+        "EDL_TPU_WORLD_SIZE": str(cluster.world_size),
+        "EDL_TPU_COORDINATOR": cluster.coordinator,
+        "EDL_TPU_CLUSTER_JSON": cluster.to_json(),
+        "EDL_TPU_JOB_ID": job.job_id,
+        "EDL_TPU_POD_ID": pod_id,
+        "EDL_TPU_CHECKPOINT_PATH": job.checkpoint_path,
+        "EDL_TPU_STORE_ENDPOINTS": job.store_endpoints,
+        "EDL_TPU_CLUSTER_VERSION": str(cluster.version),
+    })
+    return env
+
+
+def local_addr() -> str:
+    return net.host_ip()

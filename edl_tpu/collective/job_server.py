@@ -1,0 +1,250 @@
+"""JobServer/JobClient — elastic demo pair with timed-resize fault injection.
+
+The reference's flagship demo drives elasticity with an ABSENT package
+(`paddle_edl.demo.collective.job_server_demo` / `job_client_demo`,
+start_job_server.sh:11: `--time_interval_to_change 900` changes the pod set
+every 15 min — "resize is the tested fault", SURVEY.md §5). This is that
+pair, working: a small HTTP/JSON control server publishing the *desired
+node count*, and a client that keeps that many launcher processes running
+on this host.
+
+  python -m edl_tpu.collective.job_server --port 8180 \
+      --nodes-range 2:4 --time-interval-to-change 900
+  python -m edl_tpu.collective.job_server client --server :8180 -- \
+      python -m my_trainer ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.collective.job_server")
+
+
+class JobState:
+    def __init__(self, job_id: str, min_nodes: int, max_nodes: int,
+                 desired: int | None = None, seed: int = 0):
+        self.job_id = job_id
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.desired = desired if desired is not None else max_nodes
+        self._rng = random.Random(seed)
+        # RLock: resize()/random_resize() return snapshot() while holding it.
+        self._lock = threading.RLock()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"job_id": self.job_id, "desired_nodes": self.desired,
+                    "min_nodes": self.min_nodes,
+                    "max_nodes": self.max_nodes}
+
+    def resize(self, desired: int) -> dict:
+        with self._lock:
+            self.desired = max(self.min_nodes,
+                               min(self.max_nodes, desired))
+            log.info("desired_nodes -> %d", self.desired)
+            return self.snapshot()
+
+    def random_resize(self) -> dict:
+        """Fault injection: pick a different node count in [min, max]."""
+        with self._lock:
+            choices = [n for n in range(self.min_nodes, self.max_nodes + 1)
+                       if n != self.desired] or [self.desired]
+            self.desired = self._rng.choice(choices)
+            log.info("fault injection: desired_nodes -> %d", self.desired)
+            return self.snapshot()
+
+
+def _make_handler(state: JobState):
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, obj: dict, code: int = 200) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.rstrip("/") in ("", "/job"):
+                self._reply(state.snapshot())
+            else:
+                self._reply({"error": "not found"}, 404)
+
+        def do_POST(self):
+            if self.path.rstrip("/") == "/resize":
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    self._reply(state.resize(int(payload["desired"])))
+                except (ValueError, KeyError) as exc:
+                    self._reply({"error": str(exc)}, 400)
+            else:
+                self._reply({"error": "not found"}, 404)
+
+        def log_message(self, fmt, *args):  # route into our logger
+            log.debug("http: " + fmt, *args)
+
+    return Handler
+
+
+class JobServer:
+    def __init__(self, state: JobState, port: int = 8180,
+                 host: str = "0.0.0.0",
+                 time_interval_to_change: float = 0.0):
+        self.state = state
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(state))
+        self.port = self.httpd.server_address[1]
+        self.interval = time_interval_to_change
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> "JobServer":
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True,
+                             name="job-server-http")
+        t.start()
+        self._threads.append(t)
+        if self.interval > 0:
+            f = threading.Thread(target=self._fault_loop, daemon=True,
+                                 name="job-server-faults")
+            f.start()
+            self._threads.append(f)
+        log.info("JobServer on :%d desired=%d", self.port,
+                 self.state.desired)
+        return self
+
+    def _fault_loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.state.random_resize()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def get_job(server: str, timeout: float = 5.0) -> dict:
+    if server.startswith(":"):
+        server = "127.0.0.1" + server
+    if not server.startswith("http"):
+        server = "http://" + server
+    with urllib.request.urlopen(server + "/job", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def request_resize(server: str, desired: int, timeout: float = 5.0) -> dict:
+    if server.startswith(":"):
+        server = "127.0.0.1" + server
+    if not server.startswith("http"):
+        server = "http://" + server
+    req = urllib.request.Request(
+        server + "/resize", method="POST",
+        data=json.dumps({"desired": desired}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class JobClient:
+    """Keeps `desired_nodes` launcher processes running on this host.
+
+    The single-host demo topology (reference demo README: 8 pods on one
+    node): each launcher it spawns is one elastic pod; shrinking kills the
+    newest launchers and the survivors stop-resume onto the smaller world.
+    """
+
+    def __init__(self, server: str, launcher_cmd: list[str],
+                 poll: float = 2.0):
+        self.server = server
+        self.launcher_cmd = launcher_cmd
+        self.poll = poll
+        self.procs: list[subprocess.Popen] = []
+        self._stop = threading.Event()
+
+    def _reap(self) -> None:
+        self.procs = [p for p in self.procs if p.poll() is None]
+
+    def reconcile(self, desired: int) -> None:
+        self._reap()
+        while len(self.procs) < desired:
+            p = subprocess.Popen(self.launcher_cmd,
+                                 start_new_session=True)
+            log.info("spawned launcher pid=%d (%d/%d)", p.pid,
+                     len(self.procs) + 1, desired)
+            self.procs.append(p)
+        while len(self.procs) > desired:
+            p = self.procs.pop()
+            log.info("stopping launcher pid=%d", p.pid)
+            p.terminate()
+
+    def run(self) -> int:
+        try:
+            while not self._stop.is_set():
+                try:
+                    job = get_job(self.server)
+                except OSError as exc:
+                    log.warning("job server unreachable: %s", exc)
+                    time.sleep(self.poll)
+                    continue
+                self.reconcile(int(job["desired_nodes"]))
+                self._reap()
+                if not self.procs and int(job["desired_nodes"]) == 0:
+                    return 0
+                time.sleep(self.poll)
+        finally:
+            for p in self.procs:
+                p.terminate()
+        return 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "client":
+        parser = argparse.ArgumentParser(prog="edl_tpu job_server client")
+        parser.add_argument("--server", default=":8180")
+        parser.add_argument("--poll", type=float, default=2.0)
+        parser.add_argument("cmd", nargs=argparse.REMAINDER)
+        args = parser.parse_args(argv[1:])
+        cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+        if not cmd:
+            parser.error("missing launcher command (after --)")
+        return JobClient(args.server, cmd, poll=args.poll).run()
+
+    parser = argparse.ArgumentParser(prog="edl_tpu.collective.job_server")
+    parser.add_argument("--job-id", default="default_job")
+    parser.add_argument("--port", type=int, default=8180)
+    parser.add_argument("--nodes-range", default="1:4")
+    parser.add_argument("--desired", type=int, default=None)
+    parser.add_argument("--time-interval-to-change", type=float, default=0.0,
+                        help="fault injection: random resize every S seconds")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    lo, hi = (int(x) for x in args.nodes_range.split(":"))
+    state = JobState(args.job_id, lo, hi, desired=args.desired,
+                     seed=args.seed)
+    server = JobServer(state, port=args.port,
+                       time_interval_to_change=args.time_interval_to_change)
+    server.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

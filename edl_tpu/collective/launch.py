@@ -1,0 +1,168 @@
+"""Elastic launcher: register -> barrier -> spawn trainer -> watch -> loop.
+
+The working replacement for the reference's WIP launcher
+(collective/launch.py:111-194 intent: JobEnv -> pod register/watch ->
+barrier -> start_local_trainers -> on cluster change kill + re-loop) and the
+ABSENT demo JobClient pair. One launcher per TPU host.
+
+Lifecycle per generation:
+  1. claim a rank slot (CAS, leased)                       register.py
+  2. barrier until leader publishes a Cluster snapshot     barrier.py
+  3. spawn ONE trainer process with the EDL_TPU_* env       process.py
+  4. watch: membership change | lease lost | trainer exit  watcher.py
+  5. stop-resume: kill trainer, go to 2 (or 1); trainer
+     resumes from the latest checkpoint on the new mesh
+
+CLI:
+  python -m edl_tpu.collective.launch --store 127.0.0.1:2379 \
+      --nodes-range 1:4 -- python -m my_trainer --epochs 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from edl_tpu.collective import barrier as bar
+from edl_tpu.collective import register as reg
+from edl_tpu.collective.cluster import Pod
+from edl_tpu.collective.job_env import (JobEnv, local_addr, trainer_environ)
+from edl_tpu.collective.process import start_trainer, terminate_trainer
+from edl_tpu.collective.watcher import ClusterWatcher
+from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.store import Store
+from edl_tpu.utils import net
+from edl_tpu.utils.config import describe
+from edl_tpu.utils.exceptions import EdlError
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.collective.launch")
+
+
+def _job_complete(store: Store, job_id: str) -> bool:
+    return store.get(reg.complete_key(job_id)) is not None
+
+
+def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
+           max_consecutive_crashes: int = 5, poll: float = 0.5,
+           n_devices: int | None = None) -> int:
+    """Run the elastic loop until the job completes. Returns exit code."""
+    store = store or StoreClient(job.store_endpoints)
+    if n_devices is None:
+        n_devices = max(1, job.nproc_per_node)
+    pod = Pod(pod_id=job.pod_id, addr=local_addr(), port=net.free_port(),
+              n_devices=n_devices)
+    log.info("launcher starting:\n%s", describe(job))
+
+    register = reg.PodRegister(store, job.job_id, pod,
+                               max_nodes=job.max_nodes, ttl=job.lease_ttl)
+    register.claim()
+    last_version = 0
+    crashes = 0
+    trainer = None
+    watcher = None
+    try:
+        while True:
+            if _job_complete(store, job.job_id):
+                log.info("job %s complete", job.job_id)
+                return 0
+            cluster = bar.cluster_barrier(
+                store, job.job_id, pod.pod_id, after_version=last_version,
+                min_nodes=job.min_nodes, stable_secs=job.barrier_stable_secs,
+                timeout=job.barrier_timeout)
+            last_version = cluster.version
+            rank = cluster.rank_of(pod.pod_id)
+            env = trainer_environ(cluster, pod.pod_id, job)
+            trainer = start_trainer(trainer_cmd, env, job.log_dir, rank=rank)
+            watcher = ClusterWatcher(store, cluster).start()
+
+            restart_reason = None
+            while restart_reason is None:
+                time.sleep(poll)
+                if _job_complete(store, job.job_id):
+                    restart_reason = "complete"
+                elif watcher.changed.is_set():
+                    restart_reason = "membership"
+                elif register.lost.is_set():
+                    restart_reason = "lease_lost"
+                elif not trainer.alive():
+                    rc = trainer.returncode
+                    if rc == 0:
+                        # Training finished: publish completion for the
+                        # other pods (idempotent put).
+                        store.put(reg.complete_key(job.job_id), "1")
+                        restart_reason = "complete"
+                    else:
+                        crashes += 1
+                        log.warning("trainer crashed rc=%s (%d/%d)", rc,
+                                    crashes, max_consecutive_crashes)
+                        if crashes >= max_consecutive_crashes:
+                            restart_reason = "crash_loop"
+                        else:
+                            restart_reason = "crash"
+
+            watcher.stop()
+            terminate_trainer(trainer)
+            trainer = None
+            if restart_reason == "complete":
+                return 0
+            if restart_reason == "crash_loop":
+                log.error("aborting after %d consecutive crashes", crashes)
+                return 1
+            if restart_reason == "membership":
+                crashes = 0
+            if restart_reason in ("lease_lost", "crash"):
+                # Re-form the world without us first: drop our claim so the
+                # surviving pods' watchers fire, then re-claim. This is how
+                # a local trainer failure propagates into a global
+                # stop-resume (reference: pod exit -> etcd TTL drain).
+                register.release()
+                register = reg.PodRegister(store, job.job_id, pod,
+                                           max_nodes=job.max_nodes,
+                                           ttl=job.lease_ttl)
+                register.claim()
+    except EdlError as exc:
+        log.error("launcher failed: %s", exc)
+        return 2
+    finally:
+        if watcher is not None:
+            watcher.stop()
+        if trainer is not None:
+            terminate_trainer(trainer)
+        register.release()
+    return 0
+
+
+def parse_args(argv=None) -> tuple[JobEnv, list[str]]:
+    parser = argparse.ArgumentParser(
+        prog="edl_tpu.collective.launch",
+        description="Elastic TPU job launcher (flag else EDL_TPU_* env)")
+    parser.add_argument("--job-id", default=None)
+    parser.add_argument("--pod-id", default=None)
+    parser.add_argument("--store", dest="store_endpoints", default=None,
+                        help="coordination store endpoint host:port")
+    parser.add_argument("--nodes-range", default=None, help="min:max")
+    parser.add_argument("--nproc-per-node", type=int, default=None)
+    parser.add_argument("--checkpoint-path", default=None)
+    parser.add_argument("--log-dir", default=None)
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- trainer command line")
+    args = parser.parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("missing trainer command (after --)")
+    overrides = {k: v for k, v in vars(args).items()
+                 if k != "cmd" and v is not None}
+    return JobEnv.from_environ(**overrides), cmd
+
+
+def main(argv=None) -> int:
+    job, cmd = parse_args(argv)
+    return launch(job, cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

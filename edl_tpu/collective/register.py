@@ -1,0 +1,102 @@
+"""Pod rank claim: smallest-free-slot CAS race with lease keepalive.
+
+Capability of the reference's PodRegister (utils/register.py:60-88: claim
+the smallest free rank via etcd put_if_not_exists, 1s lease refresher
+thread, master = rank 0) on our coordination store.
+
+Key layout:
+    /{job}/ranks/{i}   -> Pod JSON, leased (ephemeral)   — the claim
+    /{job}/cluster     -> Cluster JSON, permanent        — leader-published
+    /{job}/complete    -> "1", permanent                 — job done marker
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from edl_tpu.collective.cluster import Pod
+from edl_tpu.coord.client import LeaseKeeper
+from edl_tpu.coord.store import Store
+from edl_tpu.utils.exceptions import EdlRegisterError
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.collective.register")
+
+
+def ranks_prefix(job_id: str) -> str:
+    return f"/{job_id}/ranks/"
+
+def rank_key(job_id: str, rank: int) -> str:
+    return f"/{job_id}/ranks/{rank:06d}"
+
+def cluster_key(job_id: str) -> str:
+    return f"/{job_id}/cluster"
+
+def complete_key(job_id: str) -> str:
+    return f"/{job_id}/complete"
+
+
+def live_pods(store: Store, job_id: str) -> tuple[list[Pod], int]:
+    """Snapshot of currently-claimed pods (sorted by claimed rank)."""
+    records, revision = store.get_prefix(ranks_prefix(job_id))
+    pods = [Pod.from_json(r.value) for r in records]
+    return sorted(pods, key=lambda p: p.claimed_rank), revision
+
+
+class PodRegister:
+    """Claim + keep a rank slot for this pod.
+
+    The claim is leased: if this process dies, the slot frees after TTL and
+    the watcher on every other pod sees the membership change (the
+    reference's ~15s etcd-TTL drain, collective/launch.py:180-183).
+    """
+
+    def __init__(self, store: Store, job_id: str, pod: Pod,
+                 max_nodes: int = 1024, ttl: float = 10.0):
+        self.store = store
+        self.job_id = job_id
+        self.pod = pod
+        self.max_nodes = max_nodes
+        self.ttl = ttl
+        self.lease: int | None = None
+        self.lost = threading.Event()
+        self._keeper: LeaseKeeper | None = None
+
+    def claim(self, timeout: float = 60.0) -> int:
+        """Race for the smallest free slot. Returns the claimed rank."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            lease = self.store.lease_grant(self.ttl)
+            for i in range(self.max_nodes):
+                self.pod.claimed_rank = i
+                if self.store.put_if_absent(rank_key(self.job_id, i),
+                                            self.pod.to_json(), lease=lease):
+                    self.lease = lease
+                    self._keeper = LeaseKeeper(
+                        self.store, lease, interval=self.ttl / 6.0,
+                        on_lost=self._on_lost).start()
+                    log.info("pod %s claimed rank %d", self.pod.pod_id, i)
+                    return i
+            # Every slot taken: revoke the unused lease and retry — a slot
+            # may free when a pod departs.
+            self.store.lease_revoke(lease)
+            time.sleep(1.0)
+        raise EdlRegisterError(
+            f"no free rank slot in {self.max_nodes} after {timeout}s")
+
+    def _on_lost(self) -> None:
+        log.error("pod %s lost its rank lease", self.pod.pod_id)
+        self.lost.set()
+
+    def refresh_value(self) -> None:
+        """Rewrite our key (e.g. after port change), keeping the lease."""
+        if self.lease is not None:
+            self.store.put(rank_key(self.job_id, self.pod.claimed_rank),
+                           self.pod.to_json(), lease=self.lease)
+
+    def release(self) -> None:
+        if self._keeper is not None:
+            self._keeper.stop(revoke=True)
+            self._keeper = None
+            self.lease = None

@@ -1,0 +1,54 @@
+"""Cluster membership watcher: sets a flag when the live pod set diverges.
+
+Capability of the reference's Watcher (utils/watcher.py:39-77: thread polls
+the etcd pod service each second, diffs pod JSON, sets `changed`).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from edl_tpu.collective.cluster import Cluster
+from edl_tpu.collective import register as reg
+from edl_tpu.coord.store import Store
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.collective.watcher")
+
+
+class ClusterWatcher:
+    """Watch the rank-claim prefix; `changed` fires when membership differs
+    from the baseline Cluster this trainer generation was formed with."""
+
+    def __init__(self, store: Store, baseline: Cluster,
+                 interval: float = 1.0):
+        self.store = store
+        self.baseline = baseline
+        self.interval = interval
+        self.changed = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"cluster-watch-{baseline.job_id}")
+
+    def start(self) -> "ClusterWatcher":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        base = self.baseline.pod_ids()
+        while not self._stop.wait(self.interval):
+            try:
+                pods, _ = reg.live_pods(self.store, self.baseline.job_id)
+            except Exception as exc:
+                log.warning("cluster watch poll failed: %s", exc)
+                continue
+            now = {p.pod_id for p in pods}
+            if now != base:
+                log.info("cluster change: %s -> %s",
+                         sorted(base), sorted(now))
+                self.changed.set()
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)

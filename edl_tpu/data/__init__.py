@@ -1,0 +1,5 @@
+from edl_tpu.data.pipeline import (ArraySource, DataLoader, epoch_indices,
+                                   prefetch, prefetch_to_device)
+
+__all__ = ["ArraySource", "DataLoader", "epoch_indices", "prefetch",
+           "prefetch_to_device"]

@@ -1,0 +1,179 @@
+"""Sharded, deterministic, prefetching input pipeline.
+
+Capability of the reference's input stack (reader_cv2 with
+`pass_id_as_seed` shuffle, shard-by-trainer-id, DALI double-buffered feed —
+example/collective/resnet50/{dali.py,utils/reader_cv2.py}) designed for the
+elastic-TPU contract:
+
+- **seed-per-pass determinism**: the epoch's global order is
+  `default_rng(seed + epoch)`; an elastic restart replays the identical
+  order, so the TrainLoop's step_in_epoch cursor skips exactly the batches
+  already consumed (train_with_fleet.py:459-464).
+- **shard-by-rank on the GLOBAL order**: rank r of world W takes indices
+  `perm[r::W]` — resharding on resize is just a different (r, W), no data
+  file re-layout.
+- **static shapes**: drop_remainder truncates to a whole number of batches
+  per shard so every jit step sees one shape (no XLA recompiles).
+- **host-side prefetch**: a daemon thread keeps a bounded queue of
+  device-placed batches so H2D transfer overlaps the device step (the DALI
+  double-buffering role).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import jax
+import numpy as np
+
+from edl_tpu.utils.exceptions import EdlDataError
+
+
+def epoch_indices(n: int, epoch: int, seed: int = 0,
+                  shuffle: bool = True) -> np.ndarray:
+    """The epoch's deterministic global sample order (seed-per-pass)."""
+    if not shuffle:
+        return np.arange(n)
+    return np.random.default_rng(seed + epoch).permutation(n)
+
+
+class ArraySource:
+    """Indexable source over a dict of equal-length arrays."""
+
+    def __init__(self, arrays: dict[str, np.ndarray]):
+        lengths = {k: len(v) for k, v in arrays.items()}
+        if len(set(lengths.values())) > 1:
+            raise EdlDataError(f"ragged arrays: {lengths}")
+        self.arrays = arrays
+        self._n = next(iter(lengths.values())) if lengths else 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def batch(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+
+class DataLoader:
+    """Deterministic sharded batch iterator.
+
+    Args:
+      source: ArraySource or anything with __len__ + batch(indices)->dict.
+      batch_size: per-RANK batch size.
+      rank/world: this trainer's shard of the global order.
+      seed: base shuffle seed; epoch is folded in per pass.
+      transforms: callables (batch_dict, np.random.Generator) -> batch_dict,
+        run on host after collation (augmentation hook); the generator is
+        seeded per (epoch, rank) so augmentation replays after a restart.
+    """
+
+    def __init__(self, source, batch_size: int, *, rank: int = 0,
+                 world: int = 1, seed: int = 0, shuffle: bool = True,
+                 drop_remainder: bool = True,
+                 transforms: Sequence[Callable] = ()):
+        if world < 1 or not (0 <= rank < world):
+            raise EdlDataError(f"bad shard rank={rank} world={world}")
+        self.source = source
+        self.batch_size = batch_size
+        self.rank = rank
+        self.world = world
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_remainder = drop_remainder
+        self.transforms = list(transforms)
+
+    def steps_per_epoch(self) -> int:
+        shard = len(self.source) // self.world if self.drop_remainder \
+            else -(-len(self.source) // self.world)
+        if self.drop_remainder:
+            return shard // self.batch_size
+        return -(-shard // self.batch_size)
+
+    def epoch(self, epoch: int) -> Iterator[dict[str, np.ndarray]]:
+        perm = epoch_indices(len(self.source), epoch, self.seed,
+                             self.shuffle)
+        mine = perm[self.rank::self.world]
+        n_steps = self.steps_per_epoch()
+        rng = np.random.default_rng(
+            (self.seed + 1) * 1_000_003 + epoch * 4093 + self.rank)
+        for i in range(n_steps):
+            idx = mine[i * self.batch_size:(i + 1) * self.batch_size]
+            if len(idx) == 0:
+                break
+            batch = self.source.batch(idx)
+            for t in self.transforms:
+                batch = t(batch, rng)
+            yield batch
+
+    def __call__(self, epoch: int) -> Iterator[dict[str, np.ndarray]]:
+        # TrainLoop's data_fn signature.
+        return self.epoch(epoch)
+
+
+_END = object()
+
+
+def prefetch(it: Iterable, size: int = 2,
+             place: Callable[[Any], Any] | None = None) -> Iterator:
+    """Run `it` in a daemon thread, keeping up to `size` items ready."""
+    q: queue.Queue = queue.Queue(maxsize=max(1, size))
+    err: list[BaseException] = []
+
+    def worker():
+        try:
+            for item in it:
+                q.put(place(item) if place else item)
+        except BaseException as exc:  # re-raised on the consumer side
+            err.append(exc)
+        finally:
+            q.put(_END)
+
+    threading.Thread(target=worker, daemon=True,
+                     name="data-prefetch").start()
+    while True:
+        item = q.get()
+        if item is _END:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
+def prefetch_to_device(it: Iterable, sharding, size: int = 2) -> Iterator:
+    """Prefetch + device placement: batches land sharded on the mesh while
+    the previous step computes (H2D overlap)."""
+
+    def place(batch):
+        return jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x), sharding), batch)
+
+    return prefetch(it, size=size, place=place)
+
+
+# -- host-side image augmentation (reference reader_cv2 capability) --------
+
+def random_flip_lr(batch: dict, rng: np.random.Generator,
+                   key: str = "image") -> dict:
+    """Per-sample horizontal flip with p=0.5 (NHWC)."""
+    imgs = batch[key]
+    flip = rng.random(len(imgs)) < 0.5
+    out = imgs.copy()
+    out[flip] = out[flip, :, ::-1]
+    return {**batch, key: out}
+
+
+def random_crop(batch: dict, rng: np.random.Generator, *, pad: int = 4,
+                key: str = "image") -> dict:
+    """Pad-and-random-crop (NHWC), the CIFAR/ImageNet-style jitter."""
+    imgs = batch[key]
+    n, h, w, c = imgs.shape
+    padded = np.pad(imgs, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                    mode="reflect")
+    ys = rng.integers(0, 2 * pad + 1, size=n)
+    xs = rng.integers(0, 2 * pad + 1, size=n)
+    out = np.empty_like(imgs)
+    for i in range(n):
+        out[i] = padded[i, ys[i]:ys[i] + h, xs[i]:xs[i] + w]
+    return {**batch, key: out}

@@ -1,0 +1,45 @@
+"""Multi-host world formation from the launcher's env contract.
+
+Replaces the reference's NCCL world bootstrap (Paddle fleet reads
+PADDLE_TRAINER_* env and broadcasts ncclUniqueId over sockets,
+utils/edl_process.py:42-47): a trainer started by
+`edl_tpu.collective.launch` calls `init_from_env()` once; on a multi-pod
+cluster this runs `jax.distributed.initialize` against the rank-0 pod's
+coordinator endpoint, after which `jax.devices()` spans all hosts and every
+mesh built on it gets its collectives compiled over ICI/DCN by XLA — there
+is no per-op communication library to configure.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from edl_tpu.collective.job_env import TrainerEnv
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.parallel.distributed")
+
+_initialized = False
+
+
+def init_from_env(env: TrainerEnv | None = None) -> TrainerEnv:
+    """Join the multi-host world described by the EDL_TPU_* env (no-op for
+    single-pod jobs or repeat calls). Returns the parsed TrainerEnv."""
+    global _initialized
+    env = env or TrainerEnv.from_environ()
+    if env.world_size > 1 and not _initialized:
+        log.info("joining world: rank=%d/%d coordinator=%s",
+                 env.rank, env.world_size, env.coordinator)
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator,
+            num_processes=env.world_size,
+            process_id=env.rank)
+        _initialized = True
+    return env
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False

@@ -1,0 +1,170 @@
+"""Elastic launcher components on InMemStore (no processes, no network).
+
+Mirrors the reference's WIP register/launch test intent
+(register_test.py env fixture, SURVEY.md §4) with the working machinery.
+"""
+
+import threading
+import time
+
+import pytest
+
+from edl_tpu.collective import barrier as bar
+from edl_tpu.collective import register as reg
+from edl_tpu.collective.cluster import Cluster, Pod, form_cluster
+from edl_tpu.collective.job_env import JobEnv, TrainerEnv, trainer_environ
+from edl_tpu.collective.watcher import ClusterWatcher
+from edl_tpu.coord.store import InMemStore
+
+JOB = "testjob"
+
+
+def make_pod(i: int, **kw) -> Pod:
+    kw.setdefault("addr", "127.0.0.1")
+    kw.setdefault("port", 20000 + i)
+    return Pod(pod_id=f"pod{i}", **kw)
+
+
+def test_cluster_round_trip_and_ranks():
+    pods = [make_pod(2, claimed_rank=7), make_pod(1, claimed_rank=3)]
+    c = form_cluster(JOB, 1, pods)
+    assert [p.pod_id for p in c.pods] == ["pod1", "pod2"]  # by claimed rank
+    assert [p.rank for p in c.pods] == [0, 1]              # dense
+    c2 = Cluster.from_json(c.to_json())
+    assert c2.pod_ids() == {"pod1", "pod2"}
+    assert c2.rank_of("pod2") == 1
+    assert c2.coordinator == "127.0.0.1:20001"
+    assert c2.same_membership(c)
+
+
+def test_rank_claim_smallest_free_slot():
+    store = InMemStore()
+    r0 = reg.PodRegister(store, JOB, make_pod(0), ttl=5.0)
+    r1 = reg.PodRegister(store, JOB, make_pod(1), ttl=5.0)
+    assert r0.claim() == 0
+    assert r1.claim() == 1
+    r0.release()
+    r2 = reg.PodRegister(store, JOB, make_pod(2), ttl=5.0)
+    assert r2.claim() == 0  # hole filled
+    for r in (r1, r2):
+        r.release()
+
+
+def test_rank_claim_concurrent_unique():
+    store = InMemStore()
+    results, regs = [], []
+
+    def claim(i):
+        r = reg.PodRegister(store, JOB, make_pod(i), ttl=5.0)
+        results.append(r.claim())
+        regs.append(r)
+
+    threads = [threading.Thread(target=claim, args=(i,)) for i in range(6)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert sorted(results) == list(range(6))
+    [r.release() for r in regs]
+
+
+def test_claim_expires_on_lease_timeout():
+    store = InMemStore()
+    r0 = reg.PodRegister(store, JOB, make_pod(0), ttl=0.3)
+    r0.claim()
+    r0._keeper.stop(revoke=False)  # simulate pod death (no keepalive)
+    time.sleep(0.7)
+    pods, _ = reg.live_pods(store, JOB)
+    assert pods == []
+
+
+def test_barrier_three_pods_one_leader():
+    store = InMemStore()
+    regs = []
+    for i in range(3):
+        r = reg.PodRegister(store, JOB, make_pod(i), ttl=5.0)
+        r.claim()
+        regs.append(r)
+    out = {}
+
+    def wait(i):
+        out[i] = bar.cluster_barrier(store, JOB, f"pod{i}",
+                                     stable_secs=0.2, timeout=10.0)
+
+    threads = [threading.Thread(target=wait, args=(i,)) for i in range(3)]
+    [t.start() for t in threads]
+    [t.join(15.0) for t in threads]
+    assert len(out) == 3
+    versions = {c.version for c in out.values()}
+    assert versions == {1}
+    assert all(c.world_size == 3 for c in out.values())
+    ranks = sorted(out[i].rank_of(f"pod{i}") for i in range(3))
+    assert ranks == [0, 1, 2]
+    [r.release() for r in regs]
+
+
+def test_barrier_resize_bumps_version():
+    store = InMemStore()
+    regs = [reg.PodRegister(store, JOB, make_pod(i), ttl=5.0)
+            for i in range(2)]
+    [r.claim() for r in regs]
+    c1 = bar.cluster_barrier(store, JOB, "pod0", stable_secs=0.1,
+                             timeout=10.0)
+    assert c1.version == 1 and c1.world_size == 2
+
+    regs[1].release()  # pod1 departs
+    c2 = bar.cluster_barrier(store, JOB, "pod0", after_version=c1.version,
+                             stable_secs=0.1, timeout=10.0)
+    assert c2.version == 2
+    assert c2.pod_ids() == {"pod0"}
+    assert c2.rank_of("pod0") == 0
+    regs[0].release()
+
+
+def test_barrier_waits_for_min_nodes():
+    store = InMemStore()
+    r = reg.PodRegister(store, JOB, make_pod(0), ttl=5.0)
+    r.claim()
+    with pytest.raises(Exception):
+        bar.cluster_barrier(store, JOB, "pod0", min_nodes=2,
+                            stable_secs=0.1, timeout=1.0)
+    r.release()
+
+
+def test_watcher_fires_on_change():
+    store = InMemStore()
+    regs = [reg.PodRegister(store, JOB, make_pod(i), ttl=5.0)
+            for i in range(2)]
+    [r.claim() for r in regs]
+    cluster = bar.cluster_barrier(store, JOB, "pod0", stable_secs=0.1,
+                                  timeout=10.0)
+    w = ClusterWatcher(store, cluster, interval=0.1).start()
+    assert not w.changed.wait(0.4)
+    regs[1].release()
+    assert w.changed.wait(3.0)
+    w.stop()
+    regs[0].release()
+
+
+def test_trainer_environ_round_trip(monkeypatch):
+    pods = [make_pod(0, claimed_rank=0, n_devices=4),
+            make_pod(1, claimed_rank=1, n_devices=4)]
+    cluster = form_cluster(JOB, 3, pods)
+    job = JobEnv(job_id=JOB, checkpoint_path="/tmp/ckpt",
+                 store_endpoints="127.0.0.1:2379")
+    env = trainer_environ(cluster, "pod1", job)
+    for k, v in env.items():
+        if k.startswith("EDL_TPU_"):
+            monkeypatch.setenv(k, v)
+    te = TrainerEnv.from_environ()
+    assert te.rank == 1 and te.world_size == 2
+    assert te.coordinator == "127.0.0.1:20000"
+    assert te.cluster_version == 3
+    assert te.cluster.n_devices == 8
+    assert not te.is_leader
+    assert te.checkpoint_path == "/tmp/ckpt"
+
+
+def test_job_env_nodes_range(monkeypatch):
+    monkeypatch.setenv("EDL_TPU_NODES_RANGE", "2:8")
+    job = JobEnv.from_environ()
+    assert (job.min_nodes, job.max_nodes) == (2, 8)
+    assert job.pod_id  # auto-generated

@@ -1,0 +1,64 @@
+"""JobServer HTTP API + fault injection + JobClient reconcile logic."""
+
+import sys
+import time
+
+from edl_tpu.collective.job_server import (JobClient, JobServer, JobState,
+                                           get_job, request_resize)
+
+
+def make_server(**kw):
+    kw.setdefault("port", 0)
+    state = JobState("j1", 1, 4, desired=2)
+    return JobServer(state, **kw).start()
+
+
+def test_get_and_resize():
+    server = make_server()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        job = get_job(addr)
+        assert job == {"job_id": "j1", "desired_nodes": 2, "min_nodes": 1,
+                       "max_nodes": 4}
+        out = request_resize(addr, 3)
+        assert out["desired_nodes"] == 3
+        # Clamped to [min, max].
+        assert request_resize(addr, 99)["desired_nodes"] == 4
+        assert request_resize(addr, 0)["desired_nodes"] == 1
+    finally:
+        server.stop()
+
+
+def test_fault_injection_changes_desired():
+    state = JobState("j1", 1, 4, desired=2, seed=7)
+    server = JobServer(state, port=0, time_interval_to_change=0.1).start()
+    try:
+        seen = set()
+        deadline = time.time() + 3.0
+        while time.time() < deadline and len(seen) < 2:
+            seen.add(get_job(f"127.0.0.1:{server.port}")["desired_nodes"])
+            time.sleep(0.05)
+        assert len(seen) >= 2, "fault injector never changed desired_nodes"
+    finally:
+        server.stop()
+
+
+def test_job_client_reconciles_process_count():
+    server = make_server()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        # A launcher stand-in that just sleeps.
+        client = JobClient(addr, [sys.executable, "-c",
+                                  "import time; time.sleep(60)"], poll=0.1)
+        client.reconcile(2)
+        assert len(client.procs) == 2
+        client.reconcile(3)
+        assert len(client.procs) == 3
+        client.reconcile(1)
+        time.sleep(0.3)
+        client._reap()
+        assert len(client.procs) == 1
+    finally:
+        for p in client.procs:
+            p.kill()
+        server.stop()
