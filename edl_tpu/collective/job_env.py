@@ -32,6 +32,10 @@ class JobEnv:
     lease_ttl: float = field(10.0, env="EDL_TPU_LEASE_TTL")
     barrier_stable_secs: float = field(2.0, env="EDL_TPU_BARRIER_STABLE")
     barrier_timeout: float = field(300.0, env="EDL_TPU_BARRIER_TIMEOUT")
+    # After a local crash/lease loss, how long to stay unregistered before
+    # re-claiming (must exceed peers' watcher poll interval so the blip is
+    # observed; the reference sleeps 15s > etcd TTL for the same reason).
+    rejoin_delay_secs: float = field(3.0, env="EDL_TPU_REJOIN_DELAY")
 
     def __post_init__(self):
         if not self.pod_id:

@@ -37,7 +37,9 @@ class JobState:
         self.job_id = job_id
         self.min_nodes = min_nodes
         self.max_nodes = max_nodes
-        self.desired = desired if desired is not None else max_nodes
+        if desired is None:
+            desired = max_nodes
+        self.desired = max(min_nodes, min(max_nodes, desired))
         self._rng = random.Random(seed)
         # RLock: resize()/random_resize() return snapshot() while holding it.
         self._lock = threading.RLock()
@@ -100,8 +102,10 @@ def _make_handler(state: JobState):
 
 class JobServer:
     def __init__(self, state: JobState, port: int = 8180,
-                 host: str = "0.0.0.0",
+                 host: str = "127.0.0.1",
                  time_interval_to_change: float = 0.0):
+        # /resize is unauthenticated, so external binding ("0.0.0.0") is an
+        # explicit operator opt-in (--host), never the default.
         self.state = state
         self.httpd = ThreadingHTTPServer((host, port), _make_handler(state))
         self.port = self.httpd.server_address[1]
@@ -225,6 +229,9 @@ def main(argv=None) -> int:
 
     parser = argparse.ArgumentParser(prog="edl_tpu.collective.job_server")
     parser.add_argument("--job-id", default="default_job")
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="bind address (0.0.0.0 exposes the "
+                             "unauthenticated /resize endpoint)")
     parser.add_argument("--port", type=int, default=8180)
     parser.add_argument("--nodes-range", default="1:4")
     parser.add_argument("--desired", type=int, default=None)
@@ -235,7 +242,7 @@ def main(argv=None) -> int:
     lo, hi = (int(x) for x in args.nodes_range.split(":"))
     state = JobState(args.job_id, lo, hi, desired=args.desired,
                      seed=args.seed)
-    server = JobServer(state, port=args.port,
+    server = JobServer(state, port=args.port, host=args.host,
                        time_interval_to_change=args.time_interval_to_change)
     server.start()
     try:

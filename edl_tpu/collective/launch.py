@@ -21,6 +21,7 @@ CLI:
 from __future__ import annotations
 
 import argparse
+import signal
 import sys
 import time
 
@@ -34,7 +35,7 @@ from edl_tpu.coord.client import StoreClient
 from edl_tpu.coord.store import Store
 from edl_tpu.utils import net
 from edl_tpu.utils.config import describe
-from edl_tpu.utils.exceptions import EdlError
+from edl_tpu.utils.exceptions import EdlError, EdlLeaseExpired
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.collective.launch")
@@ -51,7 +52,9 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
     store = store or StoreClient(job.store_endpoints)
     if n_devices is None:
         n_devices = max(1, job.nproc_per_node)
-    pod = Pod(pod_id=job.pod_id, addr=local_addr(), port=net.free_port(),
+    # port=0 placeholder: each generation assigns a fresh coordinator port
+    # at the top of the loop, before any peer can read it via the barrier.
+    pod = Pod(pod_id=job.pod_id, addr=local_addr(), port=0,
               n_devices=n_devices)
     log.info("launcher starting:\n%s", describe(job))
 
@@ -67,6 +70,22 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
             if _job_complete(store, job.job_id):
                 log.info("job %s complete", job.job_id)
                 return 0
+            # Fresh coordinator port every generation: the previous trainer
+            # may not have fully released it yet, and free_port() closes the
+            # probe socket so another process could have grabbed it since
+            # launcher start.
+            pod.port = net.free_port()
+            try:
+                register.refresh_value()
+            except EdlLeaseExpired:
+                # Lease died while we were restarting (e.g. a long stop);
+                # re-claim — claim() republishes the pod record, current
+                # port included.
+                register.release()
+                register = reg.PodRegister(store, job.job_id, pod,
+                                           max_nodes=job.max_nodes,
+                                           ttl=job.lease_ttl)
+                register.claim()
             cluster = bar.cluster_barrier(
                 store, job.job_id, pod.pod_id, after_version=last_version,
                 min_nodes=job.min_nodes, stable_secs=job.barrier_stable_secs,
@@ -82,10 +101,14 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
                 time.sleep(poll)
                 if _job_complete(store, job.job_id):
                     restart_reason = "complete"
+                elif register.lost.is_set():
+                    # Checked before `changed`: when our own lease expires
+                    # the watcher also sees the membership blip, but the
+                    # right recovery is release + re-claim, not a plain
+                    # rejoin of the (stale-lease) barrier.
+                    restart_reason = "lease_lost"
                 elif watcher.changed.is_set():
                     restart_reason = "membership"
-                elif register.lost.is_set():
-                    restart_reason = "lease_lost"
                 elif not trainer.alive():
                     rc = trainer.returncode
                     if rc == 0:
@@ -116,8 +139,13 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
                 # Re-form the world without us first: drop our claim so the
                 # surviving pods' watchers fire, then re-claim. This is how
                 # a local trainer failure propagates into a global
-                # stop-resume (reference: pod exit -> etcd TTL drain).
+                # stop-resume (reference: pod exit -> etcd TTL drain, with a
+                # deliberate 15s sleep > TTL before rejoin). The gap must
+                # stay open longer than the peers' watch poll interval or
+                # they miss the blip; peers that still miss it catch the new
+                # generation via the watcher's cluster-version check.
                 register.release()
+                time.sleep(job.rejoin_delay_secs)
                 register = reg.PodRegister(store, job.job_id, pod,
                                            max_nodes=job.max_nodes,
                                            ttl=job.lease_ttl)
@@ -159,7 +187,17 @@ def parse_args(argv=None) -> tuple[JobEnv, list[str]]:
     return JobEnv.from_environ(**overrides), cmd
 
 
+def _raise_exit(signum, frame):
+    raise SystemExit(128 + signum)
+
+
 def main(argv=None) -> int:
+    # A JobClient shrink (or operator Ctrl-C on a remote shell) delivers
+    # SIGTERM to the launcher only — the trainer runs in its own session.
+    # Convert it to SystemExit so launch()'s finally block kills the trainer
+    # tree and releases the rank claim instead of orphaning a trainer that
+    # keeps writing checkpoints against a stale world.
+    signal.signal(signal.SIGTERM, _raise_exit)
     job, cmd = parse_args(argv)
     return launch(job, cmd)
 

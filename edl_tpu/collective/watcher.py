@@ -17,8 +17,15 @@ log = get_logger("edl_tpu.collective.watcher")
 
 
 class ClusterWatcher:
-    """Watch the rank-claim prefix; `changed` fires when membership differs
-    from the baseline Cluster this trainer generation was formed with."""
+    """Watch the rank-claim prefix AND the published cluster version.
+
+    `changed` fires when (a) live membership differs from the baseline
+    Cluster this trainer generation was formed with, or (b) a cluster
+    snapshot with a *newer version* appears. (b) matters because a pod that
+    crashes and rejoins within one poll interval produces no membership
+    diff — but its barrier publishes a new generation, which every peer
+    must join or the collectives deadlock.
+    """
 
     def __init__(self, store: Store, baseline: Cluster,
                  interval: float = 1.0):
@@ -39,6 +46,9 @@ class ClusterWatcher:
         while not self._stop.wait(self.interval):
             try:
                 pods, _ = reg.live_pods(self.store, self.baseline.job_id)
+                rec = self.store.get(reg.cluster_key(self.baseline.job_id))
+                version = (Cluster.from_json(rec.value).version
+                           if rec is not None else 0)
             except Exception as exc:
                 log.warning("cluster watch poll failed: %s", exc)
                 continue
@@ -46,6 +56,11 @@ class ClusterWatcher:
             if now != base:
                 log.info("cluster change: %s -> %s",
                          sorted(base), sorted(now))
+                self.changed.set()
+                return
+            if version > self.baseline.version:
+                log.info("cluster generation advanced: v%d -> v%d",
+                         self.baseline.version, version)
                 self.changed.set()
                 return
 

@@ -117,28 +117,59 @@ _END = object()
 
 def prefetch(it: Iterable, size: int = 2,
              place: Callable[[Any], Any] | None = None) -> Iterator:
-    """Run `it` in a daemon thread, keeping up to `size` items ready."""
+    """Run `it` in a daemon thread, keeping up to `size` items ready.
+
+    Closing the returned generator (or abandoning it — e.g. a stop-resume
+    mid-epoch) stops the worker and drains queued items, so device-placed
+    batches don't stay pinned in HBM behind a thread blocked on a full
+    queue.
+    """
     q: queue.Queue = queue.Queue(maxsize=max(1, size))
     err: list[BaseException] = []
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for item in it:
-                q.put(place(item) if place else item)
+                if not _put(place(item) if place else item):
+                    return
         except BaseException as exc:  # re-raised on the consumer side
             err.append(exc)
         finally:
-            q.put(_END)
+            _put(_END)
 
-    threading.Thread(target=worker, daemon=True,
-                     name="data-prefetch").start()
-    while True:
-        item = q.get()
-        if item is _END:
-            if err:
-                raise err[0]
-            return
-        yield item
+    def gen():
+        # Worker starts lazily on first next(): a generator closed (or
+        # GC'd) before it ever runs skips the body entirely — including
+        # finally — so an eager thread could never be stopped.
+        threading.Thread(target=worker, daemon=True,
+                         name="data-prefetch").start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+
+    return gen()
 
 
 def prefetch_to_device(it: Iterable, sharding, size: int = 2) -> Iterator:

@@ -144,6 +144,27 @@ def test_watcher_fires_on_change():
     regs[0].release()
 
 
+def test_watcher_fires_on_new_generation_without_membership_blip():
+    # A pod that crashes and rejoins between two watcher polls produces no
+    # membership diff; peers must still notice the new cluster generation.
+    store = InMemStore()
+    regs = [reg.PodRegister(store, JOB, make_pod(i), ttl=5.0)
+            for i in range(2)]
+    [r.claim() for r in regs]
+    cluster = bar.cluster_barrier(store, JOB, "pod0", stable_secs=0.1,
+                                  timeout=10.0)
+    w = ClusterWatcher(store, cluster, interval=0.1).start()
+    assert not w.changed.wait(0.4)
+    # Same membership, newer version published (as the rejoined pod's
+    # barrier would do).
+    pods, _ = reg.live_pods(store, JOB)
+    nxt = form_cluster(JOB, cluster.version + 1, pods)
+    store.put(reg.cluster_key(JOB), nxt.to_json())
+    assert w.changed.wait(3.0)
+    w.stop()
+    [r.release() for r in regs]
+
+
 def test_trainer_environ_round_trip(monkeypatch):
     pods = [make_pod(0, claimed_rank=0, n_devices=4),
             make_pod(1, claimed_rank=1, n_devices=4)]

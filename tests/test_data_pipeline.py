@@ -89,6 +89,33 @@ def test_prefetch_preserves_order_and_raises():
         list(prefetch(bad(), size=2))
 
 
+def test_prefetch_abandoned_iterator_stops_worker():
+    import threading
+    import time
+
+    pulled = []
+
+    def slow_source():
+        for i in range(1000):
+            pulled.append(i)
+            yield i
+
+    it = prefetch(slow_source(), size=2)
+    assert next(it) == 0
+    it.close()  # abandon mid-stream (what a stop-resume does)
+    deadline = time.time() + 5.0
+    while time.time() < deadline and any(
+            t.name == "data-prefetch" and t.is_alive()
+            for t in threading.enumerate()):
+        time.sleep(0.05)
+    assert not any(t.name == "data-prefetch" and t.is_alive()
+                   for t in threading.enumerate()), \
+        "prefetch worker still alive after consumer closed"
+    n = len(pulled)
+    time.sleep(0.2)
+    assert len(pulled) == n, "worker kept consuming after close"
+
+
 def test_prefetch_to_device_shards_batches():
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": 8}))
     sharding = mesh_lib.data_sharding(mesh)

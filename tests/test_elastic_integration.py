@@ -99,6 +99,39 @@ def test_single_pod_completes(store_server, tmp_path):
             os.killpg(os.getpgid(p.pid), signal.SIGKILL)
 
 
+def test_sigterm_launcher_leaves_no_orphan_trainer(store_server, tmp_path):
+    # A JobClient shrink SIGTERMs the launcher only (the trainer is in its
+    # own session); the launcher must kill the trainer tree and release its
+    # rank claim instead of orphaning a trainer that keeps training.
+    store_addr, client = store_server
+    p = start_launcher(store_addr, tmp_path, "victim", epochs=100,
+                       step_time=0.5)
+    try:
+        def demo_procs():
+            # Matches the launcher too (the trainer module appears in its
+            # argv), so "orphan-free" below means zero matches once the
+            # launcher has exited.
+            out = subprocess.run(["pgrep", "-f", "edl_tpu.examples.elastic_demo"],
+                                 capture_output=True)
+            return [x for x in out.stdout.split() if x.strip()]
+
+        wait_for(lambda: read_cluster(client, "itjob") is not None, 60,
+                 "cluster formation")
+        wait_for(lambda: len(demo_procs()) >= 2, 60, "trainer start")
+        assert len(reg.live_pods(client, "itjob")[0]) == 1
+
+        os.kill(p.pid, signal.SIGTERM)  # launcher only, not the group
+        wait_for(lambda: p.poll() is not None, 30, "launcher exit")
+        wait_for(lambda: not demo_procs(), 30, "trainer cleanup")
+        # Rank claim released immediately (lease revoked, not TTL-drained).
+        assert reg.live_pods(client, "itjob")[0] == []
+    finally:
+        if p.poll() is None:
+            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+        subprocess.run(["pkill", "-9", "-f", "edl_tpu.examples.elastic_demo"],
+                       capture_output=True)
+
+
 def test_two_pods_then_pod_failure_stop_resume(store_server, tmp_path):
     store_addr, client = store_server
     a = start_launcher(store_addr, tmp_path, "podA", epochs=4,

@@ -13,6 +13,12 @@ def make_server(**kw):
     return JobServer(state, **kw).start()
 
 
+def test_job_state_clamps_initial_desired():
+    assert JobState("j1", 1, 4, desired=99).desired == 4
+    assert JobState("j1", 2, 4, desired=0).desired == 2
+    assert JobState("j1", 1, 4).desired == 4
+
+
 def test_get_and_resize():
     server = make_server()
     try:
