@@ -35,7 +35,7 @@ from edl_tpu.coord.client import StoreClient
 from edl_tpu.coord.store import Store
 from edl_tpu.utils import net
 from edl_tpu.utils.config import describe
-from edl_tpu.utils.exceptions import EdlError, EdlLeaseExpired
+from edl_tpu.utils.exceptions import EdlError
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.collective.launch")
@@ -47,14 +47,18 @@ def _job_complete(store: Store, job_id: str) -> bool:
 
 def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
            max_consecutive_crashes: int = 5, poll: float = 0.5,
-           n_devices: int | None = None) -> int:
+           n_devices: int | None = None,
+           healthy_generation_secs: float = 60.0) -> int:
     """Run the elastic loop until the job completes. Returns exit code."""
     store = store or StoreClient(job.store_endpoints)
     if n_devices is None:
         n_devices = max(1, job.nproc_per_node)
-    # port=0 placeholder: each generation assigns a fresh coordinator port
-    # at the top of the loop, before any peer can read it via the barrier.
-    pod = Pod(pod_id=job.pod_id, addr=local_addr(), port=0,
+    # The coordinator port is stable across membership restarts (published
+    # cluster snapshots embed it, so silently changing it would invalidate
+    # every snapshot) and is re-picked ONLY on the release+re-claim path,
+    # where the membership blip forces peers into a new generation built
+    # from live records anyway.
+    pod = Pod(pod_id=job.pod_id, addr=local_addr(), port=net.free_port(),
               n_devices=n_devices)
     log.info("launcher starting:\n%s", describe(job))
 
@@ -70,22 +74,6 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
             if _job_complete(store, job.job_id):
                 log.info("job %s complete", job.job_id)
                 return 0
-            # Fresh coordinator port every generation: the previous trainer
-            # may not have fully released it yet, and free_port() closes the
-            # probe socket so another process could have grabbed it since
-            # launcher start.
-            pod.port = net.free_port()
-            try:
-                register.refresh_value()
-            except EdlLeaseExpired:
-                # Lease died while we were restarting (e.g. a long stop);
-                # re-claim — claim() republishes the pod record, current
-                # port included.
-                register.release()
-                register = reg.PodRegister(store, job.job_id, pod,
-                                           max_nodes=job.max_nodes,
-                                           ttl=job.lease_ttl)
-                register.claim()
             cluster = bar.cluster_barrier(
                 store, job.job_id, pod.pod_id, after_version=last_version,
                 min_nodes=job.min_nodes, stable_secs=job.barrier_stable_secs,
@@ -95,6 +83,7 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
             env = trainer_environ(cluster, pod.pod_id, job)
             trainer = start_trainer(trainer_cmd, env, job.log_dir, rank=rank)
             watcher = ClusterWatcher(store, cluster).start()
+            generation_start = time.monotonic()
 
             restart_reason = None
             while restart_reason is None:
@@ -117,6 +106,13 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
                         store.put(reg.complete_key(job.job_id), "1")
                         restart_reason = "complete"
                     else:
+                        # A generation that trained healthily for a while
+                        # breaks the "consecutive" chain: without this,
+                        # isolated crashes days apart would accumulate into
+                        # a spurious crash_loop abort.
+                        if time.monotonic() - generation_start \
+                                > healthy_generation_secs:
+                            crashes = 0
                         crashes += 1
                         log.warning("trainer crashed rc=%s (%d/%d)", rc,
                                     crashes, max_consecutive_crashes)
@@ -146,6 +142,11 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
                 # generation via the watcher's cluster-version check.
                 register.release()
                 time.sleep(job.rejoin_delay_secs)
+                # Safe point to re-pick the coordinator port (it may still
+                # be held by the dying trainer): we are absent from the
+                # registry, so no snapshot can embed the old value, and the
+                # blip forces a new generation from live records.
+                pod.port = net.free_port()
                 register = reg.PodRegister(store, job.job_id, pod,
                                            max_nodes=job.max_nodes,
                                            ttl=job.lease_ttl)

@@ -43,12 +43,17 @@ class ClusterWatcher:
 
     def _run(self) -> None:
         base = self.baseline.pod_ids()
+        version = self.baseline.version
+        parsed_revision = -1
         while not self._stop.wait(self.interval):
             try:
                 pods, _ = reg.live_pods(self.store, self.baseline.job_id)
                 rec = self.store.get(reg.cluster_key(self.baseline.job_id))
-                version = (Cluster.from_json(rec.value).version
-                           if rec is not None else 0)
+                # Parse the snapshot only when its store revision moved —
+                # this poll runs every second on every pod.
+                if rec is not None and rec.revision != parsed_revision:
+                    version = Cluster.from_json(rec.value).version
+                    parsed_revision = rec.revision
             except Exception as exc:
                 log.warning("cluster watch poll failed: %s", exc)
                 continue

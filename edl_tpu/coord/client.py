@@ -16,11 +16,24 @@ import time
 
 from edl_tpu.coord import wire
 from edl_tpu.coord.store import Event, Record, Store
+from edl_tpu.utils import exceptions
 from edl_tpu.utils.exceptions import EdlStoreError
 from edl_tpu.utils.logging import get_logger
 from edl_tpu.utils.net import split_endpoint
 
 log = get_logger("edl_tpu.coord.client")
+
+
+def _typed_error(message: str) -> EdlStoreError:
+    """Re-hydrate server-side typed errors: the server serializes them as
+    '<TypeName>: <msg>' (coord/server.py), and callers distinguish e.g.
+    EdlLeaseExpired from generic store failures — the subtype must survive
+    the wire, not only in-process stores."""
+    name, _, rest = message.partition(":")
+    cls = getattr(exceptions, name.strip(), None)
+    if isinstance(cls, type) and issubclass(cls, EdlStoreError):
+        return cls(rest.strip() or message)
+    return EdlStoreError(message)
 
 
 class StoreClient(Store):
@@ -80,7 +93,7 @@ class StoreClient(Store):
                         raise EdlStoreError(
                             f"store rpc {req.get('op')} failed: {exc}") from exc
             if not resp.get("ok"):
-                raise EdlStoreError(resp.get("error", "unknown store error"))
+                raise _typed_error(resp.get("error", "unknown store error"))
             return resp
 
     def close(self) -> None:

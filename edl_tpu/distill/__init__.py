@@ -1,0 +1,22 @@
+"""Elastic knowledge-distillation pillar.
+
+Capability parity with the reference's headline feature (README.md:27-31,
+74-92): student trainers pull teacher predictions over the network from an
+elastic pool of inference servers, discovered and load-balanced through the
+coordination store.
+
+    teacher_server   — JAX batched-inference server (TPU/CPU)
+    registrar        — CLI registering a teacher under a service name
+    discovery_server — balancer daemon: client<->teacher assignment
+    discovery_client — student-side registration + heartbeat + server cache
+    balance          — the pure rebalance math
+    reader           — DistillReader: wraps a data reader, appends teacher
+                       predictions (the user-facing API)
+"""
+
+from edl_tpu.distill.balance import ServiceBalance
+from edl_tpu.distill.reader import DistillReader
+from edl_tpu.distill.teacher_server import TeacherClient, TeacherServer
+
+__all__ = ["ServiceBalance", "DistillReader", "TeacherClient",
+           "TeacherServer"]

@@ -1,0 +1,150 @@
+"""Client<->teacher assignment: the pure rebalance math.
+
+Capability of the reference's ``Service.rebalance`` / ``BalanceTable``
+(distill/balance_table.py:137-310): with C clients and S servers,
+
+    server_cap = ceil(C / S)        -- max clients one server feeds
+    client_cap = max(1, S // C)     -- max servers one client may use
+
+excess links are broken, then clients are greedily linked to the
+least-loaded eligible servers; a client's ``version`` bumps exactly when
+its server set changes, so heartbeats can return deltas only.
+
+Invariants (property-tested in tests/test_balance.py):
+
+  I1. every server feeds at most ``server_cap`` clients;
+  I2. every client holds at most ``client_cap`` servers;
+  I3. when S > 0, every client holds exactly ``client_cap`` servers
+      (capacity S*ceil(C/S) >= C always suffices);
+  I4. server loads are balanced: max(load) - min(load) <= 1 whenever every
+      server is eligible for every client;
+  I5. versions bump iff the client's server set changed.
+
+Unlike the reference this is a standalone, lock-free-by-construction value
+type: the discovery server owns one instance per service and serializes
+access; nothing here touches the network or the store.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+
+
+@dataclass
+class ClientLinks:
+    servers: tuple[str, ...] = ()
+    version: int = 0
+    last_seen: float = 0.0   # heartbeat bookkeeping (set by the owner)
+    meta: dict = dc_field(default_factory=dict)
+
+
+def caps(n_clients: int, n_servers: int) -> tuple[int, int]:
+    """(server_cap, client_cap) for the given population."""
+    if n_servers == 0 or n_clients == 0:
+        return 0, 0
+    server_cap = -(-n_clients // n_servers)          # ceil(C/S)
+    client_cap = max(1, n_servers // n_clients)
+    return server_cap, client_cap
+
+
+class ServiceBalance:
+    """Assignment state for one service name."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.servers: tuple[str, ...] = ()
+        self.clients: dict[str, ClientLinks] = {}
+
+    # -- membership --------------------------------------------------------
+
+    def set_servers(self, servers: list[str]) -> bool:
+        """Install the discovered teacher set. Returns True if it changed
+        (caller should rebalance)."""
+        new = tuple(sorted(set(servers)))
+        if new == self.servers:
+            return False
+        self.servers = new
+        return True
+
+    def add_client(self, client_id: str, now: float = 0.0) -> bool:
+        """Returns False if already present."""
+        if client_id in self.clients:
+            self.clients[client_id].last_seen = now
+            return False
+        self.clients[client_id] = ClientLinks(last_seen=now)
+        return True
+
+    def remove_client(self, client_id: str) -> bool:
+        return self.clients.pop(client_id, None) is not None
+
+    def touch(self, client_id: str, now: float) -> bool:
+        links = self.clients.get(client_id)
+        if links is None:
+            return False
+        links.last_seen = now
+        return True
+
+    def expire_clients(self, now: float, ttl: float) -> list[str]:
+        """Drop clients whose heartbeat is older than ttl; returns them."""
+        dead = [cid for cid, l in self.clients.items()
+                if now - l.last_seen > ttl]
+        for cid in dead:
+            del self.clients[cid]
+        return dead
+
+    # -- the rebalance -----------------------------------------------------
+
+    def rebalance(self) -> list[str]:
+        """Recompute assignments. Returns the clients whose set changed."""
+        server_cap, client_cap = caps(len(self.clients), len(self.servers))
+        load = {s: 0 for s in self.servers}
+        kept: dict[str, list[str]] = {}
+
+        # Phase 1 — keep existing links that survive caps and membership
+        # (minimizes churn: a client keeps its teachers across a rebalance
+        # whenever legal).
+        for cid in sorted(self.clients):
+            links = []
+            for s in self.clients[cid].servers:
+                if s in load and load[s] < server_cap \
+                        and len(links) < client_cap:
+                    links.append(s)
+                    load[s] += 1
+            kept[cid] = links
+
+        # Phase 2 — greedy fill to client_cap from least-loaded servers.
+        for cid in sorted(self.clients):
+            links = kept[cid]
+            while len(links) < client_cap:
+                candidates = [s for s in self.servers
+                              if load[s] < server_cap and s not in links]
+                if not candidates:
+                    break
+                best = min(candidates, key=lambda s: (load[s], s))
+                links.append(best)
+                load[best] += 1
+
+        changed = []
+        for cid, links in kept.items():
+            entry = self.clients[cid]
+            new = tuple(links)
+            if set(new) != set(entry.servers):
+                entry.servers = new
+                entry.version += 1
+                changed.append(cid)
+            else:
+                entry.servers = new  # order may differ; same set, no bump
+        return changed
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, client_id: str) -> ClientLinks | None:
+        return self.clients.get(client_id)
+
+    def loads(self) -> dict[str, int]:
+        out = {s: 0 for s in self.servers}
+        for links in self.clients.values():
+            for s in links.servers:
+                if s in out:
+                    out[s] += 1
+        return out

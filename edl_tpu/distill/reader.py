@@ -1,0 +1,361 @@
+"""DistillReader: wrap a data reader, append teacher predictions.
+
+The user-facing distill API — capability of the reference's DistillReader +
+distill_worker pipeline (distill/distill_reader.py:68,313-374,
+distill_worker.py:57-167,318-448,656-781), redesigned for the TPU host:
+
+- the reference forks a reader process + N predict processes (Paddle's
+  serving client demands it); our data plane is raw sockets + numpy, which
+  release the GIL, so the pipeline is ONE process with a reader thread, a
+  worker thread per assigned teacher, and a manage thread — same
+  concurrency, no pickling/IPC tax, and the student's JAX dispatch thread
+  is unaffected.
+
+Invariants (the reference's poison-pill/exactly-once contract, proven in
+tests/test_distill_reader.py under teacher kill/join):
+
+  D1. every yielded batch carries predictions for exactly its own rows, in
+      row order (out-of-order teacher replies are re-assembled by task id);
+  D2. batches are yielded in reader order;
+  D3. a teacher failure re-queues its in-flight task (bounded retries);
+      nothing is lost or duplicated across teacher churn;
+  D4. the epoch terminates exactly when every sliced task has been served
+      (feed-count == serve-count accounting, the poison-pill role);
+  D5. backpressure: at most ``2*teachers + 2`` tasks in flight.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator
+
+import numpy as np
+
+from edl_tpu.distill.teacher_server import TeacherClient
+from edl_tpu.utils.exceptions import EdlError
+from edl_tpu.utils.logging import get_logger
+from edl_tpu.utils.timeline import timeline
+
+log = get_logger("edl_tpu.distill.reader")
+
+
+class EdlDistillError(EdlError):
+    pass
+
+
+@dataclass
+class Task:
+    task_id: int
+    batch_id: int
+    part: int            # slice index within the batch
+    feeds: dict
+    rows: int
+    retries: int = 0
+
+
+@dataclass
+class _Batch:
+    batch: dict
+    n_parts: int = 0
+    parts: dict = field(default_factory=dict)   # part -> predictions dict
+    complete: bool = False
+
+
+class _NopTeacherClient:
+    """Fake teacher for tests/offline smoke (the reference's
+    ``_NOP_PREDICT_TEST`` trick, distill_worker.py:34-42,306-315): runs the
+    ENTIRE pipeline — slicing, workers, reordering, churn — with zero
+    network. Predictions are zeros of shape (rows, dim) per predict name."""
+
+    def __init__(self, endpoint: str, predicts: tuple[str, ...],
+                 dim: int = 1, delay: float = 0.0):
+        self.endpoint = endpoint
+        self.predicts = predicts
+        self.dim = dim
+        self.delay = delay
+
+    def predict(self, feeds: dict) -> dict:
+        if self.delay:
+            time.sleep(self.delay)
+        rows = next(iter(feeds.values())).shape[0]
+        return {name: np.zeros((rows, self.dim), np.float32)
+                for name in self.predicts}
+
+    def close(self) -> None:
+        pass
+
+
+class _PredictWorker(threading.Thread):
+    """Owns one teacher connection; serves tasks from the shared queue.
+
+    A task is owned from get() until either a successful out_queue.put or a
+    re-queue — exactly-once across worker death (invariant D3)."""
+
+    def __init__(self, pipeline: "_EpochPipeline", endpoint: str):
+        super().__init__(daemon=True, name=f"distill-predict-{endpoint}")
+        self.pipeline = pipeline
+        self.endpoint = endpoint
+        self.stop_event = threading.Event()
+        self.broken = threading.Event()
+
+    def run(self) -> None:
+        p = self.pipeline
+        tl = timeline("distill.worker")
+        try:
+            client = p.client_factory(self.endpoint)
+        except Exception as exc:
+            log.warning("connect to teacher %s failed: %s", self.endpoint, exc)
+            self.broken.set()
+            return
+        try:
+            while not self.stop_event.is_set():
+                try:
+                    task: Task = p.in_queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                try:
+                    with tl.span("predict"):
+                        outs = client.predict(task.feeds)
+                except Exception as exc:
+                    task.retries += 1
+                    log.warning("teacher %s failed task %d (try %d): %s",
+                                self.endpoint, task.task_id, task.retries,
+                                exc)
+                    if task.retries > p.max_retries:
+                        p.fail(f"task {task.task_id} failed "
+                               f"{task.retries} times: {exc}")
+                    else:
+                        p.in_queue.put(task)   # another worker re-serves it
+                    self.broken.set()
+                    return
+                missing = [k for k in p.predicts if k not in outs]
+                if missing:
+                    p.fail(f"teacher {self.endpoint} missing predicts "
+                           f"{missing}")
+                    return
+                p.out_queue.put((task, outs))
+        finally:
+            client.close()
+
+
+class _EpochPipeline:
+    """All shared state of one epoch's pipeline run."""
+
+    def __init__(self, reader: "DistillReader"):
+        self.predicts = reader.predicts
+        self.max_retries = reader.max_retries
+        self.client_factory = reader._client_factory
+        self.in_queue: queue.Queue = queue.Queue()
+        self.out_queue: queue.Queue = queue.Queue()
+        self.stop = threading.Event()
+        self.error: list[str] = []
+        n0 = max(1, len(reader._get_servers()))
+        self.sem = threading.Semaphore(2 * n0 + 2)
+        self.reader_done = threading.Event()
+        self.total_tasks = 0        # valid once reader_done is set
+        self.total_batches = 0
+
+    def fail(self, msg: str) -> None:
+        self.error.append(msg)
+        self.stop.set()
+
+    def acquire_slot(self) -> bool:
+        """Backpressure acquire that stays responsive to stop."""
+        while not self.stop.is_set():
+            if self.sem.acquire(timeout=0.1):
+                return True
+        return False
+
+
+class DistillReader:
+    """Wrap ``reader`` so iteration yields its batches + teacher predicts.
+
+    Args:
+      reader: callable returning an iterator of dict batches (equal leading
+        dim), or an iterable of such batches. ``DataLoader.epoch(e)`` fits.
+      feeds: batch keys sent to the teacher.
+      predicts: teacher output names appended to each batch.
+      teachers: fixed teacher endpoint list (reference set_fixed_teacher);
+        OR
+      discovery: endpoints of discovery servers + ``service`` for dynamic
+        teacher assignment.
+      teacher_batch_size: rows per teacher RPC (reference default 16).
+
+    Env: ``EDL_TPU_DISTILL_NOP=1`` swaps real connections for nop teachers
+    (offline smoke; tests inject ``client_factory`` directly).
+    """
+
+    def __init__(self, reader, feeds: Iterable[str],
+                 predicts: Iterable[str], *,
+                 teachers: list[str] | None = None,
+                 discovery: str | None = None, service: str | None = None,
+                 teacher_batch_size: int = 16, max_retries: int = 3,
+                 manage_interval: float = 0.5,
+                 client_factory: Callable | None = None,
+                 rpc_timeout: float = 30.0):
+        if teachers is None and discovery is None:
+            raise EdlDistillError("need fixed `teachers` or `discovery`")
+        self.reader = reader
+        self.feeds = tuple(feeds)
+        self.predicts = tuple(predicts)
+        self.teacher_batch_size = teacher_batch_size
+        self.max_retries = max_retries
+        self.manage_interval = manage_interval
+        self._fixed_teachers = list(teachers) if teachers else None
+        self._discovery_endpoints = discovery
+        self._service = service
+        self._discovery_client = None
+        if client_factory is None:
+            if os.environ.get("EDL_TPU_DISTILL_NOP", "0") == "1":
+                client_factory = lambda ep: _NopTeacherClient(  # noqa: E731
+                    ep, self.predicts)
+            else:
+                client_factory = lambda ep: TeacherClient(  # noqa: E731
+                    ep, timeout=rpc_timeout)
+        self._client_factory = client_factory
+
+    # -- teacher set --------------------------------------------------------
+
+    def _get_servers(self) -> list[str]:
+        if self._fixed_teachers is not None:
+            return self._fixed_teachers
+        if self._discovery_client is None:
+            from edl_tpu.distill.discovery_client import DiscoveryClient
+            self._discovery_client = DiscoveryClient(
+                self._discovery_endpoints, self._service or "distill").start()
+        return self._discovery_client.get_servers()
+
+    def set_fixed_teachers(self, teachers: list[str]) -> None:
+        """Swap the fixed teacher set (reference set_fixed_teacher)."""
+        self._fixed_teachers = list(teachers)
+
+    def close(self) -> None:
+        if self._discovery_client is not None:
+            self._discovery_client.stop()
+            self._discovery_client = None
+
+    # -- pipeline threads ---------------------------------------------------
+
+    def _reader_thread(self, p: _EpochPipeline) -> None:
+        tl = timeline("distill.reader")
+        task_id = 0
+        batch_id = 0
+        try:
+            it = self.reader() if callable(self.reader) else iter(self.reader)
+            for batch in it:
+                if p.stop.is_set():
+                    return
+                rows = next(iter(batch.values())).shape[0]
+                n_parts = -(-rows // self.teacher_batch_size)
+                p.out_queue.put(("batch", batch_id, batch, n_parts))
+                for part in range(n_parts):
+                    lo = part * self.teacher_batch_size
+                    hi = min(lo + self.teacher_batch_size, rows)
+                    feeds = {k: np.ascontiguousarray(batch[k][lo:hi])
+                             for k in self.feeds}
+                    task = Task(task_id, batch_id, part, feeds, hi - lo)
+                    task_id += 1
+                    with tl.span("feed"):
+                        if not p.acquire_slot():
+                            return
+                    p.in_queue.put(task)
+                batch_id += 1
+        except Exception as exc:
+            p.fail(f"reader failed: {type(exc).__name__}: {exc}")
+        finally:
+            p.total_tasks = task_id
+            p.total_batches = batch_id
+            p.reader_done.set()
+
+    def _manage_thread(self, p: _EpochPipeline,
+                       workers: dict[str, _PredictWorker]) -> None:
+        """Diff discovered teachers vs. worker pool (reference
+        predict_manage_worker, distill_worker.py:57-161)."""
+        while not p.stop.is_set():
+            try:
+                desired = set(self._get_servers())
+            except Exception as exc:
+                log.warning("teacher discovery failed: %s", exc)
+                desired = set(workers)
+            for ep in list(workers):
+                w = workers[ep]
+                if ep not in desired or w.broken.is_set() \
+                        or not w.is_alive():
+                    w.stop_event.set()
+                    if not w.is_alive():
+                        workers.pop(ep)
+            for ep in desired:
+                if ep not in workers:
+                    w = _PredictWorker(p, ep)
+                    workers[ep] = w
+                    w.start()
+            if p.stop.wait(self.manage_interval):
+                return
+
+    # -- the generator ------------------------------------------------------
+
+    def __call__(self) -> Iterator[dict]:
+        p = _EpochPipeline(self)
+        workers: dict[str, _PredictWorker] = {}
+        threads = [
+            threading.Thread(target=self._reader_thread, args=(p,),
+                             daemon=True, name="distill-reader"),
+            threading.Thread(target=self._manage_thread, args=(p, workers),
+                             daemon=True, name="distill-manage"),
+        ]
+        [t.start() for t in threads]
+        tl = timeline("distill.fetch")
+
+        pending: dict[int, _Batch] = {}
+        next_yield = 0
+        served_tasks = 0
+        seen: set[tuple[int, int]] = set()
+        try:
+            while True:
+                if p.error:
+                    raise EdlDistillError("; ".join(p.error))
+                if (p.reader_done.is_set() and served_tasks == p.total_tasks
+                        and next_yield == p.total_batches):
+                    return                      # D4: exactly-once epoch end
+                try:
+                    item = p.out_queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                if item[0] == "batch":
+                    _, bid, batch, n_parts = item
+                    entry = pending.setdefault(bid, _Batch(batch))
+                    entry.batch = batch
+                    entry.n_parts = n_parts
+                    entry.complete = n_parts == 0
+                else:
+                    task, outs = item
+                    key = (task.batch_id, task.part)
+                    if key in seen:
+                        raise EdlDistillError(f"duplicate serve for {key}")
+                    seen.add(key)
+                    served_tasks += 1
+                    p.sem.release()
+                    entry = pending.setdefault(task.batch_id, _Batch({}))
+                    entry.parts[task.part] = outs
+                    if entry.n_parts and len(entry.parts) == entry.n_parts:
+                        entry.complete = True
+                # D2: yield strictly in reader order.
+                while next_yield in pending and pending[next_yield].complete:
+                    entry = pending.pop(next_yield)
+                    with tl.span("assemble"):
+                        merged = dict(entry.batch)
+                        for name in self.predicts:
+                            merged[name] = np.concatenate(
+                                [entry.parts[i][name]
+                                 for i in range(entry.n_parts)], axis=0) \
+                                if entry.n_parts else np.zeros((0, 1))
+                    yield merged
+                    next_yield += 1
+        finally:
+            p.stop.set()
+            for w in workers.values():
+                w.stop_event.set()

@@ -1,0 +1,99 @@
+"""Teacher registrar: put a serving endpoint into the distill registry.
+
+Capability of the reference's ServerRegister CLIs
+(discovery/register.py:29-143 and distill/redis/server_register.py:20-136):
+wait until the teacher server answers TCP, then register it under the
+service name with a TTL lease; the Registration keeps the lease alive and
+re-registers after expiry (bounded retries). Deregistration on stop.
+
+CLI (run next to each teacher server):
+    python -m edl_tpu.distill.registrar --store 127.0.0.1:2379 \
+        --service resnet_teacher --server 10.0.0.7:23900
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.registry import Registration, ServiceRegistry
+from edl_tpu.coord.store import Store
+from edl_tpu.utils import net
+from edl_tpu.utils.exceptions import EdlRegisterError
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.distill.registrar")
+
+DISTILL_ROOT = "edl_distill"
+
+
+class TeacherRegistrar:
+    """Probe-then-register lifecycle for one teacher endpoint."""
+
+    def __init__(self, store: Store, service: str, server: str, *,
+                 info: str = "", ttl: float = 10.0, root: str = DISTILL_ROOT,
+                 probe_timeout: float = 60.0, probe_interval: float = 0.5):
+        self.registry = ServiceRegistry(store, root=root)
+        self.service = service
+        self.server = server
+        self.info = info
+        self.ttl = ttl
+        self.probe_timeout = probe_timeout
+        self.probe_interval = probe_interval
+        self._registration: Registration | None = None
+
+    def wait_alive(self) -> None:
+        deadline = time.monotonic() + self.probe_timeout
+        while time.monotonic() < deadline:
+            if net.is_endpoint_alive(self.server):
+                return
+            time.sleep(self.probe_interval)
+        raise EdlRegisterError(
+            f"teacher {self.server} not answering after {self.probe_timeout}s")
+
+    def start(self) -> "TeacherRegistrar":
+        self.wait_alive()
+        self._registration = self.registry.register(
+            self.service, self.server, info=self.info, ttl=self.ttl)
+        log.info("registered teacher %s under %s", self.server, self.service)
+        return self
+
+    def stop(self, deregister: bool = True) -> None:
+        if self._registration is not None:
+            self._registration.stop()
+            self._registration = None
+        if deregister:
+            try:
+                self.registry.deregister(self.service, self.server)
+            except Exception as exc:
+                log.warning("deregister failed: %s", exc)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="edl_tpu.distill.registrar",
+        description="Register a teacher inference server for discovery")
+    parser.add_argument("--store", default="127.0.0.1:2379")
+    parser.add_argument("--service", required=True)
+    parser.add_argument("--server", required=True, help="host:port to expose")
+    parser.add_argument("--info", default="",
+                        help="opaque utilization/meta string")
+    parser.add_argument("--ttl", type=float, default=10.0)
+    parser.add_argument("--root", default=DISTILL_ROOT)
+    parser.add_argument("--probe-timeout", type=float, default=60.0)
+    args = parser.parse_args(argv)
+    registrar = TeacherRegistrar(
+        StoreClient(args.store), args.service, args.server, info=args.info,
+        ttl=args.ttl, root=args.root, probe_timeout=args.probe_timeout)
+    registrar.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        registrar.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

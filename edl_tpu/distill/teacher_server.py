@@ -1,0 +1,345 @@
+"""JAX teacher inference server: batched forward serving over tensor wire.
+
+The TPU-native stand-in for the reference's Paddle Serving teacher
+(README.md:74-92; students call it through distill_worker.py:203-226). One
+process drives the local TPU chips; a coalescing batcher concatenates
+concurrent client requests into one device batch and pads to a fixed
+bucket so XLA compiles once per bucket (static shapes — no recompiles on
+ragged tails). This coalescing is what Paddle Serving gave the reference
+for free and SURVEY.md §7 flags as a hard part of hitting ≥1500 img/s.
+
+Protocol (tensor_wire frames):
+    request  meta {"op": "predict"}          tensors {feed_name: array}
+    response meta {"ok": true}               tensors {fetch_name: array}
+    request  meta {"op": "ping"}             -> {"ok": true}, no tensors
+
+CLI (serves a zoo model with random or checkpointed params):
+    python -m edl_tpu.distill.teacher_server --model mlp --port 23900
+"""
+
+from __future__ import annotations
+
+import argparse
+import queue
+import socket
+import socketserver
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from edl_tpu.distill import tensor_wire
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.distill.teacher_server")
+
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def pad_to_bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return n  # beyond the largest bucket: serve exact (rare, recompiles)
+
+
+@dataclass
+class _Request:
+    tensors: dict[str, np.ndarray]
+    rows: int
+    done: threading.Event = field(default_factory=threading.Event)
+    result: dict[str, np.ndarray] | None = None
+    error: str | None = None
+
+
+class Batcher:
+    """Coalesce concurrent predict requests into padded device batches."""
+
+    def __init__(self, predict_fn, *, max_batch: int = 64,
+                 max_wait: float = 0.002,
+                 buckets: tuple[int, ...] = DEFAULT_BUCKETS):
+        self.predict_fn = predict_fn
+        self.max_batch = max_batch
+        self.max_wait = max_wait
+        self.buckets = tuple(sorted(buckets))
+        self._q: queue.Queue[_Request | None] = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="teacher-batcher")
+
+    def start(self) -> "Batcher":
+        self._thread.start()
+        return self
+
+    def submit(self, tensors: dict[str, np.ndarray]) -> _Request:
+        rows = next(iter(tensors.values())).shape[0] if tensors else 0
+        req = _Request(tensors=tensors, rows=rows)
+        self._q.put(req)
+        return req
+
+    def _collect(self) -> list[_Request]:
+        """One blocking pop, then drain whatever arrives within max_wait
+        (bounded by max_batch rows)."""
+        try:
+            first = self._q.get(timeout=0.2)
+        except queue.Empty:
+            return []
+        if first is None:
+            return []
+        group, rows = [first], first.rows
+        deadline = time.monotonic() + self.max_wait
+        while rows < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                req = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if req is None:
+                break
+            if rows + req.rows > self.max_batch:
+                # Doesn't fit this round: run it in the next group.
+                self._q.put(req)
+                break
+            group.append(req)
+            rows += req.rows
+        return group
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            group = self._collect()
+            if not group:
+                continue
+            try:
+                self._serve_group(group)
+            except Exception as exc:
+                log.exception("batch predict failed")
+                for req in group:
+                    req.error = f"{type(exc).__name__}: {exc}"
+                    req.done.set()
+
+    def _serve_group(self, group: list[_Request]) -> None:
+        names = list(group[0].tensors)
+        for req in group[1:]:
+            if list(req.tensors) != names:
+                # Heterogeneous feeds can't coalesce; serve separately.
+                self._serve_group([req])
+        group = [g for g in group if list(g.tensors) == names]
+        rows = sum(g.rows for g in group)
+        bucket = pad_to_bucket(rows, self.buckets)
+        feeds = {}
+        for name in names:
+            cat = np.concatenate([g.tensors[name] for g in group], axis=0)
+            if bucket > rows:
+                pad = np.zeros((bucket - rows,) + cat.shape[1:], cat.dtype)
+                cat = np.concatenate([cat, pad], axis=0)
+            feeds[name] = cat
+        outs = self.predict_fn(feeds)
+        outs = {k: np.asarray(v) for k, v in outs.items()}
+        offset = 0
+        for req in group:
+            req.result = {k: v[offset:offset + req.rows]
+                          for k, v in outs.items()}
+            offset += req.rows
+            req.done.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        batcher: Batcher = self.server.batcher  # type: ignore[attr-defined]
+        sock: socket.socket = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            try:
+                meta, tensors = tensor_wire.recv_tensors(sock)
+            except (tensor_wire.TensorWireError, OSError):
+                return
+            try:
+                resp_meta, resp_tensors = self._dispatch(batcher, meta,
+                                                         tensors)
+            except Exception as exc:
+                resp_meta = {"ok": False,
+                             "error": f"{type(exc).__name__}: {exc}"}
+                resp_tensors = {}
+            try:
+                tensor_wire.send_tensors(sock, resp_meta, resp_tensors)
+            except OSError:
+                return
+
+    @staticmethod
+    def _dispatch(batcher: Batcher, meta: dict, tensors: dict):
+        op = meta.get("op")
+        if op == "ping":
+            return {"ok": True}, {}
+        if op == "predict":
+            if not tensors:
+                return {"ok": False, "error": "no feed tensors"}, {}
+            req = batcher.submit(tensors)
+            req.done.wait()
+            if req.error is not None:
+                return {"ok": False, "error": req.error}, {}
+            return {"ok": True}, req.result
+        return {"ok": False, "error": f"unknown op {op!r}"}, {}
+
+
+class _ThreadingServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class TeacherServer:
+    """In-process handle: serve `predict_fn` on a TCP port.
+
+    predict_fn: dict[str, np.ndarray] -> dict[str, np.ndarray]; typically a
+    jitted model apply. Called only from the batcher thread, with batch
+    sizes drawn from `buckets` — so jit compiles once per bucket.
+    """
+
+    def __init__(self, predict_fn, *, port: int = 0, host: str = "0.0.0.0",
+                 max_batch: int = 64, max_wait: float = 0.002,
+                 buckets: tuple[int, ...] = DEFAULT_BUCKETS):
+        self.batcher = Batcher(predict_fn, max_batch=max_batch,
+                               max_wait=max_wait, buckets=buckets)
+        self._server = _ThreadingServer((host, port), _Handler)
+        self._server.batcher = self.batcher  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._started = False
+
+    def start(self) -> "TeacherServer":
+        if self._started:
+            return self
+        self._started = True
+        self.batcher.start()
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="teacher-serve").start()
+        log.info("teacher server on :%d", self.port)
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self.batcher.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class TeacherClient:
+    """Blocking client of one teacher server (used by DistillReader's
+    predict workers; the reference counterpart wraps paddle_serving_client,
+    distill_worker.py:187-282)."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0):
+        from edl_tpu.utils.net import split_endpoint
+        self.endpoint = endpoint
+        host, port = split_endpoint(endpoint)
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def predict(self, feeds: dict[str, np.ndarray]
+                ) -> dict[str, np.ndarray]:
+        tensor_wire.send_tensors(self._sock, {"op": "predict"}, feeds)
+        meta, tensors = tensor_wire.recv_tensors(self._sock)
+        if not meta.get("ok"):
+            raise tensor_wire.TensorWireError(
+                meta.get("error", "predict failed"))
+        return tensors
+
+    def ping(self) -> bool:
+        try:
+            tensor_wire.send_tensors(self._sock, {"op": "ping"})
+            meta, _ = tensor_wire.recv_tensors(self._sock)
+            return bool(meta.get("ok"))
+        except (tensor_wire.TensorWireError, OSError):
+            return False
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _build_model_predict(model_name: str, num_classes: int, params_path: str,
+                         input_key: str, output_key: str,
+                         input_shape: tuple[int, ...] = (32, 32, 3)):
+    """CLI helper: jitted zoo-model forward with random or restored params."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu import models as zoo
+    from edl_tpu.train.classification import create_state
+    import optax
+
+    factory = getattr(zoo, model_name)
+    model = factory(num_classes=num_classes)
+    # Dense layers bind their kernel to the flattened input size, so init
+    # must see the shape that will be served.
+    state = create_state(model, jax.random.PRNGKey(0), (1,) + input_shape,
+                         optax.identity())
+    if params_path:
+        from edl_tpu.train.checkpoint import CheckpointManager
+        restored = CheckpointManager(params_path).restore(state)
+        if restored is not None:
+            state = restored[0]
+
+    @jax.jit
+    def forward(images):
+        variables = {"params": state.params}
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+        return model.apply(variables, images, train=False)
+
+    def predict(feeds):
+        logits = forward(jnp.asarray(feeds[input_key]))
+        return {output_key: np.asarray(logits, np.float32)}
+
+    return predict
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="edl_tpu.distill.teacher_server",
+        description="Serve a zoo model as a distill teacher")
+    parser.add_argument("--model", default="mlp",
+                        help="edl_tpu.models factory name (mlp, resnet50_vd, ...)")
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--params", default="",
+                        help="checkpoint dir to restore params from")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=23900)
+    parser.add_argument("--input-key", default="image")
+    parser.add_argument("--output-key", default="logits")
+    parser.add_argument("--input-shape", default="32,32,3",
+                        help="per-sample input shape, e.g. 28,28,1")
+    parser.add_argument("--max-batch", type=int, default=64)
+    parser.add_argument("--max-wait-ms", type=float, default=2.0)
+    args = parser.parse_args(argv)
+    shape = tuple(int(x) for x in args.input_shape.split(","))
+    predict = _build_model_predict(args.model, args.num_classes, args.params,
+                                   args.input_key, args.output_key, shape)
+    server = TeacherServer(predict, port=args.port, host=args.host,
+                           max_batch=args.max_batch,
+                           max_wait=args.max_wait_ms / 1000.0)
+    server.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

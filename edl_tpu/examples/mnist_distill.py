@@ -1,0 +1,134 @@
+"""MNIST-scale distillation demo: teacher serving + DistillReader student.
+
+Capability of the reference's minimal distill recipe
+(example/distill/mnist_distill/train_with_fleet.py:134-145 and
+example/distill/README.md:11-31): a student trains against teacher logits
+pulled over the network from an elastic teacher pool.
+
+Modes:
+  --all-in-one          spin an in-process teacher (MLP, fixed seed) and
+                        train against it — zero external services;
+  --teachers h:p,h:p    fixed teacher endpoints (teacher_server CLI);
+  --discovery h:p --service svc
+                        dynamic discovery via the balancer daemon.
+
+Data is synthetic (deterministic), sized like MNIST; no downloads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from edl_tpu.data.pipeline import ArraySource, DataLoader
+from edl_tpu.distill.reader import DistillReader
+from edl_tpu.distill.teacher_server import TeacherServer
+from edl_tpu.models.mlp import MLP
+from edl_tpu.train.classification import (create_state, make_distill_step,
+                                          make_eval_step)
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.examples.mnist_distill")
+
+IMG_SHAPE = (28, 28, 1)
+NUM_CLASSES = 10
+
+
+def synthetic_mnist(n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    images = rng.normal(size=(n,) + IMG_SHAPE).astype(np.float32)
+    # Labels come from a fixed random projection so they are learnable.
+    w = np.random.default_rng(123).normal(
+        size=(int(np.prod(IMG_SHAPE)), NUM_CLASSES)).astype(np.float32)
+    labels = (images.reshape(n, -1) @ w).argmax(axis=1).astype(np.int32)
+    return {"image": images, "label": labels}
+
+
+def make_teacher_predict(seed: int = 42):
+    """Jitted forward of a fixed-weight teacher MLP."""
+    model = MLP(num_classes=NUM_CLASSES, hidden=(512, 256))
+    variables = jax.jit(model.init)(jax.random.PRNGKey(seed),
+                                    jnp.zeros((1,) + IMG_SHAPE))
+
+    @jax.jit
+    def forward(images):
+        return model.apply(variables, images, train=False)
+
+    def predict(feeds):
+        return {"teacher_logits":
+                np.asarray(forward(jnp.asarray(feeds["image"])), np.float32)}
+
+    return predict
+
+
+def train(args) -> int:
+    data = synthetic_mnist(args.samples, seed=args.seed)
+    loader = DataLoader(ArraySource(data), args.batch_size, seed=args.seed)
+
+    server = None
+    teachers = None
+    if args.all_in_one:
+        server = TeacherServer(make_teacher_predict(), host="127.0.0.1",
+                               max_batch=args.teacher_batch_size * 4).start()
+        teachers = [f"127.0.0.1:{server.port}"]
+    elif args.teachers:
+        teachers = args.teachers.split(",")
+
+    student = MLP(num_classes=NUM_CLASSES, hidden=(64,))
+    tx = optax.adam(args.lr)
+    state = create_state(student, jax.random.PRNGKey(args.seed),
+                         (1,) + IMG_SHAPE, tx)
+    step = make_distill_step(NUM_CLASSES, temperature=args.temperature,
+                             hard_weight=args.hard_weight)
+    eval_step = make_eval_step()
+
+    try:
+        for epoch in range(args.epochs):
+            dr = DistillReader(
+                lambda e=epoch: loader.epoch(e), feeds=["image"],
+                predicts=["teacher_logits"], teachers=teachers,
+                discovery=args.discovery or None, service=args.service,
+                teacher_batch_size=args.teacher_batch_size)
+            losses = []
+            for batch in dr():
+                state, metrics = step(state, batch)
+                losses.append(float(metrics["loss"]))
+            dr.close()
+            ev = eval_step(state, {"image": jnp.asarray(data["image"][:512]),
+                                   "label": jnp.asarray(data["label"][:512])})
+            log.info("epoch %d loss=%.4f acc1=%.3f", epoch,
+                     float(np.mean(losses)), float(ev["acc1"]))
+        print(f"final_loss={np.mean(losses):.4f}")
+        return 0
+    finally:
+        if server is not None:
+            server.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="edl_tpu.examples.mnist_distill")
+    parser.add_argument("--all-in-one", action="store_true")
+    parser.add_argument("--teachers", default="")
+    parser.add_argument("--discovery", default="")
+    parser.add_argument("--service", default="mnist_teacher")
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--samples", type=int, default=2048)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--teacher-batch-size", type=int, default=16)
+    parser.add_argument("--temperature", type=float, default=2.0)
+    parser.add_argument("--hard-weight", type=float, default=0.3)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    if not (args.all_in_one or args.teachers or args.discovery):
+        parser.error("pick --all-in-one, --teachers or --discovery")
+    return train(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

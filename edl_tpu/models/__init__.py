@@ -8,6 +8,7 @@ _LAZY = {
                "BottleneckBlock"),
     "vgg": ("VGG", "VGG11", "VGG13", "VGG16", "VGG19"),
     "transformer": ("Transformer", "TransformerConfig"),
+    "mlp": ("MLP", "mlp"),
     "bow": ("BOWClassifier",),
     "deepfm": ("DeepFM",),
 }

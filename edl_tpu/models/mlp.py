@@ -1,0 +1,29 @@
+"""Small MLP classifier — the mnist-scale teacher/student model.
+
+Capability parity: the reference's mnist distill recipe uses a tiny
+teacher served to students (example/distill/mnist_distill/
+train_with_fleet.py:134-145); this is the CPU-testable model both sides of
+our distill pipeline use in tests and demos.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+
+class MLP(nn.Module):
+    num_classes: int = 10
+    hidden: Sequence[int] = (256, 128)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def mlp(num_classes: int = 10, **kw) -> MLP:
+    return MLP(num_classes=num_classes, **kw)

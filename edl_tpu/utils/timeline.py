@@ -1,0 +1,75 @@
+"""Env-gated per-stage host timeline profiler.
+
+Capability of the reference's distill timeline (distill/timeline.py:20-43:
+``DISTILL_READER_PROFILE=1`` swaps a nop for a real recorder emitting
+``pid/op/ms`` lines to stderr, hooked at every pipeline stage). Ours is
+``EDL_TPU_PROFILE=1`` and also offers a jax-profiler trace context for
+device-side timelines.
+
+    tl = timeline("distill.worker")      # nop unless EDL_TPU_PROFILE=1
+    with tl.span("predict"):
+        ...
+    tl.record("put_data", t0)            # explicit start time
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import time
+
+
+class _NopTimeline:
+    __slots__ = ()
+
+    def span(self, op: str):
+        return contextlib.nullcontext()
+
+    def record(self, op: str, start: float) -> None:
+        pass
+
+    enabled = False
+
+
+class _RealTimeline:
+    __slots__ = ("name",)
+    enabled = True
+
+    def __init__(self, name: str):
+        self.name = name
+
+    @contextlib.contextmanager
+    def span(self, op: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.record(op, t0)
+
+    def record(self, op: str, start: float) -> None:
+        ms = (time.monotonic() - start) * 1000.0
+        print(f"timeline pid={os.getpid()} {self.name}.{op} {ms:.3f}ms",
+              file=sys.stderr, flush=True)
+
+
+def profiling_enabled() -> bool:
+    return os.environ.get("EDL_TPU_PROFILE", "0") == "1"
+
+
+def timeline(name: str):
+    """Nop unless EDL_TPU_PROFILE=1 (zero overhead on the hot path)."""
+    return _RealTimeline(name) if profiling_enabled() else _NopTimeline()
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """jax profiler trace (TensorBoard-viewable) around a code region —
+    the device-side analogue of the reference's --profile batches window
+    (train_with_fleet.py:521-530)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,161 @@
+"""Property tests for the distill rebalance math (balance.py invariants
+I1-I5; reference distill/balance_table.py:244-310 formulas)."""
+
+import random
+
+from edl_tpu.distill.balance import ServiceBalance, caps
+
+
+def check_invariants(svc: ServiceBalance, fresh: bool = False):
+    C, S = len(svc.clients), len(svc.servers)
+    server_cap, client_cap = caps(C, S)
+    loads = svc.loads()
+    for s, load in loads.items():
+        assert load <= server_cap, f"I1: {s} load {load} > {server_cap}"
+    for cid, links in svc.clients.items():
+        assert len(links.servers) <= max(client_cap, 0), "I2"
+        assert len(set(links.servers)) == len(links.servers), "dup links"
+        assert all(s in svc.servers for s in links.servers), "stale link"
+    if S > 0 and C > 0:
+        for cid, links in svc.clients.items():
+            assert len(links.servers) == client_cap, \
+                f"I3: {cid} has {len(links.servers)} != {client_cap}"
+        if fresh:
+            # I4 holds only for from-scratch assignment: incremental
+            # rebalances deliberately keep legal existing links (minimal
+            # churn, like the reference's break-excess-only policy), which
+            # can leave a newly joined server under-loaded.
+            assert max(loads.values()) - min(loads.values()) <= 1, \
+                f"I4: unbalanced {loads}"
+
+
+def test_caps_formulas():
+    assert caps(10, 3) == (4, 1)     # ceil(10/3), max(1, 0)
+    assert caps(2, 8) == (1, 4)
+    assert caps(3, 3) == (1, 1)
+    assert caps(1, 40) == (1, 40)    # the EDL headline shape: 40 teachers
+    assert caps(0, 5) == (0, 0)
+    assert caps(5, 0) == (0, 0)
+
+
+def test_single_client_gets_all_servers():
+    svc = ServiceBalance("s")
+    svc.set_servers([f"t{i}" for i in range(5)])
+    svc.add_client("c0")
+    svc.rebalance()
+    assert set(svc.get("c0").servers) == {f"t{i}" for i in range(5)}
+
+
+def test_more_clients_than_servers_shares():
+    svc = ServiceBalance("s")
+    svc.set_servers(["t0", "t1"])
+    for i in range(5):
+        svc.add_client(f"c{i}")
+    svc.rebalance()
+    check_invariants(svc, fresh=True)
+    # 5 clients / 2 servers: every client exactly 1 server, loads {3, 2}.
+    assert sorted(svc.loads().values()) == [2, 3]
+
+
+def test_fresh_assignment_balanced():
+    for C, S in [(7, 6), (6, 7), (10, 3), (3, 10), (16, 16)]:
+        svc = ServiceBalance("s")
+        svc.set_servers([f"t{i}" for i in range(S)])
+        for i in range(C):
+            svc.add_client(f"c{i}")
+        svc.rebalance()
+        check_invariants(svc, fresh=True)
+
+
+def test_version_bumps_iff_set_changes():
+    svc = ServiceBalance("s")
+    svc.set_servers(["t0", "t1"])
+    svc.add_client("c0")
+    svc.rebalance()
+    v1 = svc.get("c0").version
+    assert v1 == 1  # from empty to assigned
+
+    svc.rebalance()  # no membership change
+    assert svc.get("c0").version == v1
+
+    svc.set_servers(["t0", "t1", "t2"])
+    changed = svc.rebalance()
+    assert changed == ["c0"]
+    assert svc.get("c0").version == v1 + 1
+
+
+def test_minimal_churn_on_server_join():
+    # A client keeps its current teacher when a new teacher joins and the
+    # caps still allow the old link.
+    svc = ServiceBalance("s")
+    svc.set_servers(["t0"])
+    svc.add_client("c0")
+    svc.add_client("c1")
+    svc.rebalance()
+    before = {cid: set(svc.get(cid).servers) for cid in ("c0", "c1")}
+    svc.set_servers(["t0", "t1"])
+    svc.rebalance()
+    check_invariants(svc)
+    # Each client now has exactly 1 server and at least one client kept t0.
+    kept = sum("t0" in svc.get(cid).servers and "t0" in before[cid]
+               for cid in ("c0", "c1"))
+    assert kept >= 1
+
+
+def test_random_join_leave_fuzz():
+    rng = random.Random(1234)
+    svc = ServiceBalance("s")
+    servers: set[str] = set()
+    clients: set[str] = set()
+    next_id = [0, 0]
+    for step in range(400):
+        action = rng.random()
+        if action < 0.25:
+            servers.add(f"t{next_id[0]}")
+            next_id[0] += 1
+        elif action < 0.45 and servers:
+            servers.discard(rng.choice(sorted(servers)))
+        elif action < 0.75:
+            cid = f"c{next_id[1]}"
+            next_id[1] += 1
+            clients.add(cid)
+            svc.add_client(cid)
+        elif clients:
+            cid = rng.choice(sorted(clients))
+            clients.discard(cid)
+            svc.remove_client(cid)
+        svc.set_servers(sorted(servers))
+        svc.rebalance()
+        check_invariants(svc)
+
+
+def test_versions_monotone_and_delta_consistent():
+    # Simulate the heartbeat protocol: a client that replays every version
+    # change ends with exactly the final assignment.
+    rng = random.Random(7)
+    svc = ServiceBalance("s")
+    svc.add_client("c0")
+    known_version = -1
+    cached: tuple = ()
+    for step in range(100):
+        n = rng.randint(0, 6)
+        svc.set_servers([f"t{i}" for i in range(n)])
+        svc.rebalance()
+        links = svc.get("c0")
+        if links.version != known_version:   # what heartbeat returns
+            cached = links.servers
+            known_version = links.version
+        assert cached == svc.get("c0").servers
+
+
+def test_expire_clients():
+    svc = ServiceBalance("s")
+    svc.set_servers(["t0"])
+    svc.add_client("c0", now=0.0)
+    svc.add_client("c1", now=5.0)
+    svc.rebalance()
+    dead = svc.expire_clients(now=8.0, ttl=6.0)
+    assert dead == ["c0"]
+    assert set(svc.clients) == {"c1"}
+    svc.rebalance()
+    check_invariants(svc)

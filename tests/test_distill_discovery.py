@@ -1,0 +1,175 @@
+"""Discovery server/client/registrar integration over real TCP + InMemStore.
+
+Mirrors the reference's test_distill_reader.sh flow (etcd + registrar +
+discovery server + client) without external binaries: the coordination
+store is in-process, the discovery wire is real sockets.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from edl_tpu.coord.registry import ServiceRegistry
+from edl_tpu.coord.store import InMemStore
+from edl_tpu.distill.discovery_client import DiscoveryClient, EdlDiscoveryError
+from edl_tpu.distill.discovery_server import (BALANCE_SERVICE,
+                                              DiscoveryServer)
+from edl_tpu.distill.registrar import TeacherRegistrar
+
+
+@pytest.fixture
+def store():
+    return InMemStore()
+
+
+@pytest.fixture
+def registry(store):
+    return ServiceRegistry(store, root="edl_distill")
+
+
+def make_server(store, **kw):
+    kw.setdefault("port", 0)
+    kw.setdefault("host", "127.0.0.1")   # loopback bind => loopback advertise
+    kw.setdefault("tick_interval", 0.1)
+    return DiscoveryServer(store, **kw).start()
+
+
+def test_register_heartbeat_assignment(store, registry):
+    regs = [registry.register("svc", f"127.0.0.1:{9000+i}", ttl=5.0)
+            for i in range(2)]
+    with make_server(store) as _srv:
+        client = DiscoveryClient(_srv.endpoint, "svc",
+                                 heartbeat_interval=0.1).start()
+        try:
+            servers = client.wait_for_servers(timeout=10.0)
+            assert set(servers) == {"127.0.0.1:9000", "127.0.0.1:9001"}
+
+            # Teacher joins: the heartbeat delta must install it.
+            regs.append(registry.register("svc", "127.0.0.1:9002", ttl=5.0))
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if len(client.get_servers()) == 3:
+                    break
+                time.sleep(0.05)
+            assert len(client.get_servers()) == 3
+
+            # Teacher leaves: assignment shrinks.
+            regs[0].stop()
+            registry.deregister("svc", "127.0.0.1:9000")
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if "127.0.0.1:9000" not in client.get_servers():
+                    break
+                time.sleep(0.05)
+            assert "127.0.0.1:9000" not in client.get_servers()
+        finally:
+            client.stop()
+            for r in regs[1:]:
+                r.stop()
+
+
+def test_two_clients_share_one_teacher(store, registry):
+    reg = registry.register("svc", "127.0.0.1:9100", ttl=5.0)
+    with make_server(store) as srv:
+        c1 = DiscoveryClient(srv.endpoint, "svc",
+                             heartbeat_interval=0.1).start()
+        c2 = DiscoveryClient(srv.endpoint, "svc",
+                             heartbeat_interval=0.1).start()
+        try:
+            assert c1.wait_for_servers(10.0) == ["127.0.0.1:9100"]
+            assert c2.wait_for_servers(10.0) == ["127.0.0.1:9100"]
+        finally:
+            c1.stop()
+            c2.stop()
+            reg.stop()
+
+
+def test_silent_client_expires(store, registry):
+    reg = registry.register("svc", "127.0.0.1:9200", ttl=5.0)
+    with make_server(store, client_ttl=0.5) as srv:
+        client = DiscoveryClient(srv.endpoint, "svc",
+                                 heartbeat_interval=60.0).start()  # silent
+        try:
+            client.wait_for_servers(10.0)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                stats = srv.table.stats()
+                if stats.get("svc", {}).get("clients") == 0:
+                    break
+                time.sleep(0.05)
+            assert srv.table.stats()["svc"]["clients"] == 0
+        finally:
+            client.stop()
+            reg.stop()
+
+
+def test_redirect_to_shard_owner(store, registry):
+    reg = registry.register("svc", "127.0.0.1:9300", ttl=5.0)
+    a = make_server(store)
+    b = make_server(store)
+    try:
+        # Let both replicas see each other in the __balance__ ring.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if (len(a.table._ring.nodes) == 2
+                    and len(b.table._ring.nodes) == 2):
+                break
+            time.sleep(0.05)
+        owner = a.table.owner_of("svc")
+        assert owner == b.table.owner_of("svc"), "replicas disagree on owner"
+        other = a if owner == b.endpoint else b
+
+        client = DiscoveryClient(other.endpoint, "svc",
+                                 heartbeat_interval=0.1).start()
+        try:
+            client.wait_for_servers(10.0)
+            assert client._connected_to == owner, \
+                "client not redirected to the shard owner"
+        finally:
+            client.stop()
+    finally:
+        a.stop()
+        b.stop()
+        reg.stop()
+
+
+def test_registrar_probes_then_registers(store, registry):
+    # Teacher endpoint that starts listening only after a delay: the
+    # registrar must wait for aliveness, then the discovery path sees it.
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    port = lst.getsockname()[1]
+    endpoint = f"127.0.0.1:{port}"
+
+    def listen_later():
+        time.sleep(0.5)
+        lst.listen(1)
+
+    threading.Thread(target=listen_later, daemon=True).start()
+    registrar = TeacherRegistrar(store, "svc", endpoint, ttl=5.0,
+                                 probe_timeout=10.0, probe_interval=0.1)
+    t0 = time.monotonic()
+    registrar.start()
+    assert time.monotonic() - t0 >= 0.3, "registered before server was up"
+    try:
+        metas = registry.get_service("svc")
+        assert [m.server for m in metas] == [endpoint]
+    finally:
+        registrar.stop()
+        lst.close()
+    assert registry.get_service("svc") == []
+
+
+def test_registrar_times_out_when_never_alive(store):
+    registrar = TeacherRegistrar(store, "svc", "127.0.0.1:1",  # closed port
+                                 probe_timeout=0.5, probe_interval=0.1)
+    with pytest.raises(Exception):
+        registrar.start()
+
+
+def test_discovery_replicas_register_in_ring(store, registry):
+    with make_server(store) as srv:
+        metas = registry.get_service(BALANCE_SERVICE)
+        assert [m.server for m in metas] == [srv.endpoint]

@@ -1,0 +1,83 @@
+"""Full distill stack end-to-end: store + discovery + registrars + real
+teacher servers + DistillReader in dynamic-discovery mode + elastic churn.
+
+The working analogue of the reference's test_distill_reader.sh flow
+(etcd + register + discovery_server + DistillReader, SURVEY.md §4) with a
+teacher join AND a teacher kill mid-run — the "elastically resized teacher
+pool, student unaffected" pillar (README.md:27-31).
+"""
+
+import time
+
+import numpy as np
+
+from edl_tpu.coord.store import InMemStore
+from edl_tpu.distill.discovery_server import DiscoveryServer
+from edl_tpu.distill.reader import DistillReader
+from edl_tpu.distill.registrar import TeacherRegistrar
+from edl_tpu.distill.teacher_server import TeacherServer
+
+
+def ref_logits(images):
+    return np.stack([images.sum(axis=1), images.max(axis=1)], axis=1)
+
+
+def predict(feeds):
+    time.sleep(0.005)
+    return {"teacher_logits": ref_logits(feeds["image"])}
+
+
+def make_batches(n_batches, rows=16, feat=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"image": rng.normal(size=(rows, feat)).astype(np.float32)}
+            for _ in range(n_batches)]
+
+
+def start_teacher(store):
+    srv = TeacherServer(predict, host="127.0.0.1").start()
+    endpoint = f"127.0.0.1:{srv.port}"
+    registrar = TeacherRegistrar(store, "svc", endpoint, ttl=1.0,
+                                 probe_timeout=10.0, probe_interval=0.05)
+    registrar.start()
+    return srv, registrar, endpoint
+
+
+def test_discovery_driven_distill_with_churn():
+    store = InMemStore()
+    t1 = start_teacher(store)
+    disco = DiscoveryServer(store, port=0, host="127.0.0.1",
+                            tick_interval=0.1, client_ttl=10.0).start()
+    batches = make_batches(n_batches=20)
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"],
+                       discovery=disco.endpoint, service="svc",
+                       teacher_batch_size=4, manage_interval=0.05)
+    t2 = None
+    try:
+        it = dr()
+        got = [next(it)]
+
+        # Teacher JOINS mid-epoch: discovery assigns it; throughput grows.
+        t2 = start_teacher(store)
+        got.append(next(it))
+
+        # First teacher DIES mid-epoch (server + registrar): its lease
+        # expires, discovery rebalances onto the survivor, in-flight tasks
+        # re-queue. Student never notices.
+        t1[0].stop()
+        t1[1].stop()
+
+        for item in it:
+            got.append(item)
+
+        assert len(got) == len(batches)
+        for want, out in zip(batches, got):
+            np.testing.assert_array_equal(out["image"], want["image"])
+            np.testing.assert_allclose(out["teacher_logits"],
+                                       ref_logits(want["image"]), rtol=1e-6)
+    finally:
+        dr.close()
+        disco.stop()
+        if t2 is not None:
+            t2[0].stop()
+            t2[1].stop()

@@ -1,0 +1,60 @@
+"""Student training through the distill pipeline: the KD loss consumer
+(make_distill_step) fed by DistillReader against a real teacher server.
+
+Done-criterion from the round-1 verdict: "a student training run consuming
+it via make_distill_step"."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from edl_tpu.data.pipeline import ArraySource, DataLoader
+from edl_tpu.distill.reader import DistillReader
+from edl_tpu.distill.teacher_server import TeacherServer
+from edl_tpu.models.mlp import MLP
+from edl_tpu.train.classification import create_state, make_distill_step
+
+
+def test_student_learns_from_served_teacher():
+    # Teacher: fixed-weight MLP; data labeled BY the teacher so the KD
+    # objective is learnable.
+    teacher = MLP(num_classes=10, hidden=(32,))
+    tvars = jax.jit(teacher.init)(jax.random.PRNGKey(42),
+                                  jnp.zeros((1, 16)))
+
+    @jax.jit
+    def tforward(x):
+        return teacher.apply(tvars, x, train=False)
+
+    def predict(feeds):
+        return {"teacher_logits":
+                np.asarray(tforward(jnp.asarray(feeds["image"])), np.float32)}
+
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(512, 16)).astype(np.float32)
+    labels = np.asarray(tforward(jnp.asarray(images))).argmax(1).astype(np.int32)
+    loader = DataLoader(ArraySource({"image": images, "label": labels}), 64,
+                        seed=0)
+
+    student = MLP(num_classes=10, hidden=(32,))
+    state = create_state(student, jax.random.PRNGKey(0), (1, 16),
+                         optax.adam(1e-2))
+    step = make_distill_step(10, temperature=2.0, hard_weight=0.0)
+
+    with TeacherServer(predict, host="127.0.0.1") as srv:
+        accs = []
+        for epoch in range(16):
+            dr = DistillReader(lambda e=epoch: loader.epoch(e),
+                               feeds=["image"], predicts=["teacher_logits"],
+                               teachers=[f"127.0.0.1:{srv.port}"],
+                               teacher_batch_size=16)
+            for batch in dr():
+                state, metrics = step(state, batch)
+                accs.append(float(metrics["acc1"]))
+    # The KD loss has a constant floor (soft-CE includes teacher entropy),
+    # so progress is measured as student->teacher agreement: labels here
+    # ARE the teacher's argmax.
+    first, last = np.mean(accs[:8]), np.mean(accs[-8:])
+    assert last > max(0.5, first + 0.2), \
+        f"no learning: agreement {first:.3f} -> {last:.3f}"

@@ -11,7 +11,7 @@ import pytest
 
 from edl_tpu.coord.client import LeaseKeeper, StoreClient
 from edl_tpu.coord.server import StoreServer
-from edl_tpu.utils.exceptions import EdlStoreError
+from edl_tpu.utils.exceptions import EdlLeaseExpired, EdlStoreError
 
 
 @pytest.fixture
@@ -35,6 +35,16 @@ def test_roundtrip(client):
     assert [r.key for r in recs] == ["/a"]
     assert client.delete("/a")
     assert client.get("/a") is None
+
+
+def test_typed_errors_survive_the_wire(client):
+    # A put against a dead lease must raise EdlLeaseExpired (the subtype,
+    # not just EdlStoreError) even through the TCP client — launcher
+    # recovery paths dispatch on it.
+    lease = client.lease_grant(5.0)
+    assert client.lease_revoke(lease)
+    with pytest.raises(EdlLeaseExpired):
+        client.put("/dead", "1", lease=lease)
 
 
 def test_cas_over_wire(client):
