@@ -1,0 +1,361 @@
+// Minimal JSON value + parser + serializer for the edl-store wire protocol.
+//
+// Self-contained (no external deps; the toolchain contract forbids
+// pip/apt installs). Supports the full JSON grammar the Python side can
+// produce via json.dumps: null/bool/number/string/object/array, with
+// \uXXXX escapes (incl. surrogate pairs) -> UTF-8.
+//
+// Capability note: the reference ships Go+protobuf native components
+// (pkg/master, SURVEY.md §2.2); our native plane speaks the framework's
+// framed-JSON store protocol (edl_tpu/coord/wire.py) instead.
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace edl {
+
+class Json;
+using JsonObject = std::map<std::string, Json>;
+using JsonArray = std::vector<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Object, Array };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Int), int_(v) {}
+  Json(int64_t v) : type_(Type::Int), int_(v) {}
+  Json(uint64_t v) : type_(Type::Int), int_(static_cast<int64_t>(v)) {}
+  Json(double v) : type_(Type::Double), double_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonObject o) : type_(Type::Object),
+                       obj_(std::make_shared<JsonObject>(std::move(o))) {}
+  Json(JsonArray a) : type_(Type::Array),
+                      arr_(std::make_shared<JsonArray>(std::move(a))) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_object() const { return type_ == Type::Object; }
+
+  bool as_bool(bool fallback = false) const {
+    return type_ == Type::Bool ? bool_ : fallback;
+  }
+  int64_t as_int(int64_t fallback = 0) const {
+    if (type_ == Type::Int) return int_;
+    if (type_ == Type::Double) return static_cast<int64_t>(double_);
+    return fallback;
+  }
+  double as_double(double fallback = 0.0) const {
+    if (type_ == Type::Double) return double_;
+    if (type_ == Type::Int) return static_cast<double>(int_);
+    return fallback;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  const JsonObject& as_object() const {
+    static const JsonObject empty;
+    return type_ == Type::Object && obj_ ? *obj_ : empty;
+  }
+  const JsonArray& as_array() const {
+    static const JsonArray empty;
+    return type_ == Type::Array && arr_ ? *arr_ : empty;
+  }
+
+  // Object field access (Null if absent).
+  const Json& operator[](const std::string& key) const {
+    static const Json null_value;
+    if (type_ != Type::Object || !obj_) return null_value;
+    auto it = obj_->find(key);
+    return it == obj_->end() ? null_value : it->second;
+  }
+  bool has(const std::string& key) const {
+    return type_ == Type::Object && obj_ && obj_->count(key) > 0;
+  }
+
+  std::string dump() const {
+    std::string out;
+    dump_to(out);
+    return out;
+  }
+
+  static Json parse(const std::string& text);
+
+ private:
+  void dump_to(std::string& out) const;
+  static void escape_to(const std::string& s, std::string& out);
+
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0.0;
+  std::string str_;
+  std::shared_ptr<JsonObject> obj_;
+  std::shared_ptr<JsonArray> arr_;
+};
+
+struct JsonParseError : std::runtime_error {
+  explicit JsonParseError(const std::string& msg)
+      : std::runtime_error("json parse error: " + msg) {}
+};
+
+namespace detail {
+
+class Parser {
+ public:
+  Parser(const char* begin, const char* end) : p_(begin), end_(end) {}
+
+  Json parse_document() {
+    Json v = parse_value();
+    skip_ws();
+    if (p_ != end_) throw JsonParseError("trailing data");
+    return v;
+  }
+
+ private:
+  void skip_ws() {
+    while (p_ != end_ && (*p_ == ' ' || *p_ == '\t' || *p_ == '\n' ||
+                          *p_ == '\r'))
+      ++p_;
+  }
+  char peek() {
+    skip_ws();
+    if (p_ == end_) throw JsonParseError("unexpected end");
+    return *p_;
+  }
+  char next() {
+    char c = peek();
+    ++p_;
+    return c;
+  }
+  void expect(const char* lit) {
+    for (const char* q = lit; *q; ++q) {
+      if (p_ == end_ || *p_ != *q) throw JsonParseError("bad literal");
+      ++p_;
+    }
+  }
+
+  Json parse_value() {
+    char c = peek();
+    switch (c) {
+      case '{': return parse_object();
+      case '[': return parse_array();
+      case '"': return Json(parse_string());
+      case 't': expect("true"); return Json(true);
+      case 'f': expect("false"); return Json(false);
+      case 'n': expect("null"); return Json(nullptr);
+      default: return parse_number();
+    }
+  }
+
+  Json parse_object() {
+    next();  // '{'
+    JsonObject obj;
+    if (peek() == '}') { ++p_; return Json(std::move(obj)); }
+    while (true) {
+      if (peek() != '"') throw JsonParseError("expected key");
+      std::string key = parse_string();
+      if (next() != ':') throw JsonParseError("expected ':'");
+      obj.emplace(std::move(key), parse_value());
+      char c = next();
+      if (c == '}') break;
+      if (c != ',') throw JsonParseError("expected ',' or '}'");
+    }
+    return Json(std::move(obj));
+  }
+
+  Json parse_array() {
+    next();  // '['
+    JsonArray arr;
+    if (peek() == ']') { ++p_; return Json(std::move(arr)); }
+    while (true) {
+      arr.push_back(parse_value());
+      char c = next();
+      if (c == ']') break;
+      if (c != ',') throw JsonParseError("expected ',' or ']'");
+    }
+    return Json(std::move(arr));
+  }
+
+  std::string parse_string() {
+    if (next() != '"') throw JsonParseError("expected string");
+    std::string out;
+    while (true) {
+      if (p_ == end_) throw JsonParseError("unterminated string");
+      unsigned char c = static_cast<unsigned char>(*p_++);
+      if (c == '"') break;
+      if (c == '\\') {
+        if (p_ == end_) throw JsonParseError("bad escape");
+        char e = *p_++;
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            unsigned cp = parse_hex4();
+            if (cp >= 0xD800 && cp <= 0xDBFF) {  // surrogate pair
+              if (p_ + 1 >= end_ || p_[0] != '\\' || p_[1] != 'u')
+                throw JsonParseError("lone high surrogate");
+              p_ += 2;
+              unsigned lo = parse_hex4();
+              if (lo < 0xDC00 || lo > 0xDFFF)
+                throw JsonParseError("bad low surrogate");
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default: throw JsonParseError("bad escape char");
+        }
+      } else {
+        out += static_cast<char>(c);
+      }
+    }
+    return out;
+  }
+
+  unsigned parse_hex4() {
+    unsigned v = 0;
+    for (int i = 0; i < 4; ++i) {
+      if (p_ == end_) throw JsonParseError("bad \\u escape");
+      char c = *p_++;
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= static_cast<unsigned>(c - '0');
+      else if (c >= 'a' && c <= 'f') v |= static_cast<unsigned>(c - 'a' + 10);
+      else if (c >= 'A' && c <= 'F') v |= static_cast<unsigned>(c - 'A' + 10);
+      else throw JsonParseError("bad hex digit");
+    }
+    return v;
+  }
+
+  static void append_utf8(std::string& out, unsigned cp) {
+    if (cp < 0x80) {
+      out += static_cast<char>(cp);
+    } else if (cp < 0x800) {
+      out += static_cast<char>(0xC0 | (cp >> 6));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += static_cast<char>(0xE0 | (cp >> 12));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    } else {
+      out += static_cast<char>(0xF0 | (cp >> 18));
+      out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+      out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+      out += static_cast<char>(0x80 | (cp & 0x3F));
+    }
+  }
+
+  Json parse_number() {
+    const char* start = p_;
+    if (p_ != end_ && *p_ == '-') ++p_;
+    bool is_double = false;
+    while (p_ != end_ && ((*p_ >= '0' && *p_ <= '9') || *p_ == '.' ||
+                          *p_ == 'e' || *p_ == 'E' || *p_ == '+' ||
+                          *p_ == '-')) {
+      if (*p_ == '.' || *p_ == 'e' || *p_ == 'E') is_double = true;
+      ++p_;
+    }
+    std::string num(start, static_cast<size_t>(p_ - start));
+    if (num.empty() || num == "-") throw JsonParseError("bad number");
+    try {
+      if (!is_double) return Json(static_cast<int64_t>(std::stoll(num)));
+      return Json(std::stod(num));
+    } catch (const std::out_of_range&) {
+      return Json(std::stod(num));
+    } catch (const std::invalid_argument&) {
+      throw JsonParseError("bad number " + num);
+    }
+  }
+
+  const char* p_;
+  const char* end_;
+};
+
+}  // namespace detail
+
+inline Json Json::parse(const std::string& text) {
+  detail::Parser parser(text.data(), text.data() + text.size());
+  return parser.parse_document();
+}
+
+inline void Json::escape_to(const std::string& s, std::string& out) {
+  out += '"';
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += static_cast<char>(c);  // UTF-8 bytes pass through
+        }
+    }
+  }
+  out += '"';
+}
+
+inline void Json::dump_to(std::string& out) const {
+  switch (type_) {
+    case Type::Null: out += "null"; break;
+    case Type::Bool: out += bool_ ? "true" : "false"; break;
+    case Type::Int: out += std::to_string(int_); break;
+    case Type::Double: {
+      char buf[32];
+      std::snprintf(buf, sizeof(buf), "%.17g", double_);
+      out += buf;
+      break;
+    }
+    case Type::String: escape_to(str_, out); break;
+    case Type::Object: {
+      out += '{';
+      bool first = true;
+      for (const auto& kv : *obj_) {
+        if (!first) out += ',';
+        first = false;
+        escape_to(kv.first, out);
+        out += ':';
+        kv.second.dump_to(out);
+      }
+      out += '}';
+      break;
+    }
+    case Type::Array: {
+      out += '[';
+      bool first = true;
+      for (const auto& v : *arr_) {
+        if (!first) out += ',';
+        first = false;
+        v.dump_to(out);
+      }
+      out += ']';
+      break;
+    }
+  }
+}
+
+}  // namespace edl

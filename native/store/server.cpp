@@ -1,0 +1,260 @@
+// edl-store: native coordination-store daemon.
+//
+// Speaks the framework's framed-JSON protocol (edl_tpu/coord/wire.py:
+// 4-byte magic "EDL1" + u32 big-endian length + JSON body) with the exact
+// InMemStore semantics, so the Python StoreClient and every test works
+// against either server. Thread-per-connection + a lease sweeper thread
+// (TTL expiry generates DELETE events even with no traffic), mirroring
+// edl_tpu/coord/server.py. Adds what the Python dev server lacks:
+// WAL+snapshot durability (--data-dir).
+//
+//   edl-store --port 2379 --data-dir /var/lib/edl-store
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <iostream>
+#include <thread>
+
+#include "store.hpp"
+
+namespace edl {
+
+constexpr char kMagic[4] = {'E', 'D', 'L', '1'};
+constexpr uint32_t kMaxBody = 64 * 1024 * 1024;
+
+static bool recv_exact(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, buf + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool send_all(int fd, const char* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool recv_msg(int fd, Json* out) {
+  char header[8];
+  if (!recv_exact(fd, header, sizeof(header))) return false;
+  if (std::memcmp(header, kMagic, 4) != 0) return false;
+  uint32_t len;
+  std::memcpy(&len, header + 4, 4);
+  len = ntohl(len);
+  if (len > kMaxBody) return false;
+  std::string body(len, '\0');
+  if (!recv_exact(fd, body.data(), len)) return false;
+  try {
+    *out = Json::parse(body);
+  } catch (const JsonParseError&) {
+    return false;
+  }
+  return true;
+}
+
+static bool send_msg(int fd, const Json& msg) {
+  std::string body = msg.dump();
+  uint32_t len = htonl(static_cast<uint32_t>(body.size()));
+  std::string frame(kMagic, 4);
+  frame.append(reinterpret_cast<char*>(&len), 4);
+  frame += body;
+  return send_all(fd, frame.data(), frame.size());
+}
+
+static Json ok(JsonObject fields = {}) {
+  fields.emplace("ok", Json(true));
+  return Json(std::move(fields));
+}
+
+static Json err(const std::string& message) {
+  return Json(JsonObject{{"ok", Json(false)}, {"error", Json(message)}});
+}
+
+static Json record_json(const Record& rec) {
+  return Json(JsonArray{Json(rec.key), Json(rec.value), Json(rec.revision),
+                        Json(rec.lease)});
+}
+
+static Json dispatch(Store& store, const Json& req) {
+  const std::string& op = req["op"].as_string();
+  if (op == "put") {
+    int64_t rev = store.put(req["key"].as_string(), req["value"].as_string(),
+                            req["lease"].as_int());
+    return ok({{"revision", Json(rev)}});
+  }
+  if (op == "get") {
+    auto rec = store.get(req["key"].as_string());
+    if (!rec) return ok({{"record", Json(nullptr)}});
+    return ok({{"record", record_json(*rec)}});
+  }
+  if (op == "get_prefix") {
+    auto [recs, rev] = store.get_prefix(req["prefix"].as_string());
+    JsonArray arr;
+    for (const auto& rec : recs) arr.push_back(record_json(rec));
+    return ok({{"revision", Json(rev)}, {"records", Json(std::move(arr))}});
+  }
+  if (op == "delete")
+    return ok({{"deleted", Json(store.del(req["key"].as_string()))}});
+  if (op == "delete_prefix")
+    return ok(
+        {{"count", Json(store.delete_prefix(req["prefix"].as_string()))}});
+  if (op == "put_if_absent") {
+    bool won = store.put_if_absent(req["key"].as_string(),
+                                   req["value"].as_string(),
+                                   req["lease"].as_int());
+    return ok({{"won", Json(won)}});
+  }
+  if (op == "cas") {
+    std::optional<std::string> expect;
+    if (req.has("expect") && !req["expect"].is_null())
+      expect = req["expect"].as_string();
+    bool won = store.compare_and_swap(req["key"].as_string(), expect,
+                                      req["value"].as_string(),
+                                      req["lease"].as_int());
+    return ok({{"won", Json(won)}});
+  }
+  if (op == "lease_grant")
+    return ok({{"lease", Json(store.lease_grant(req["ttl"].as_double()))}});
+  if (op == "lease_keepalive")
+    return ok({{"alive", Json(store.lease_keepalive(req["lease"].as_int()))}});
+  if (op == "lease_revoke")
+    return ok({{"revoked", Json(store.lease_revoke(req["lease"].as_int()))}});
+  if (op == "events_since") {
+    auto [events, rev, compacted] = store.events_since(
+        req["revision"].as_int(), req["prefix"].as_string());
+    JsonArray arr;
+    for (const auto& ev : events)
+      arr.push_back(Json(JsonArray{Json(ev.type), Json(ev.key),
+                                   Json(ev.value), Json(ev.revision)}));
+    return ok({{"revision", Json(rev)},
+               {"compacted", Json(compacted)},
+               {"events", Json(std::move(arr))}});
+  }
+  if (op == "ping") return ok();
+  return err("unknown op '" + op + "'");
+}
+
+static void serve_connection(Store* store, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  Json req;
+  while (recv_msg(fd, &req)) {
+    Json resp;
+    try {
+      resp = dispatch(*store, req);
+    } catch (const LeaseExpiredError& e) {
+      resp = err(std::string("EdlLeaseExpired: ") + e.what());
+    } catch (const std::exception& e) {
+      resp = err(std::string("InternalError: ") + e.what());
+    }
+    if (!send_msg(fd, resp)) break;
+  }
+  ::close(fd);
+}
+
+}  // namespace edl
+
+static std::atomic<bool> g_stop{false};
+static void on_signal(int) { g_stop = true; }
+
+int main(int argc, char** argv) {
+  std::string host = "0.0.0.0";
+  int port = 2379;
+  std::string data_dir;
+  double sweep_interval = 0.5;
+  bool fsync = true;
+  long snapshot_every = 8192;
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto need = [&](const char* flag) -> std::string {
+      if (i + 1 >= argc) {
+        std::cerr << flag << " needs a value\n";
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (arg == "--host") host = need("--host");
+    else if (arg == "--port") port = std::stoi(need("--port"));
+    else if (arg == "--data-dir") data_dir = need("--data-dir");
+    else if (arg == "--sweep-interval")
+      sweep_interval = std::stod(need("--sweep-interval"));
+    else if (arg == "--no-fsync") fsync = false;
+    else if (arg == "--snapshot-every")
+      snapshot_every = std::stol(need("--snapshot-every"));
+    else {
+      std::cerr << "usage: edl-store [--host H] [--port P] [--data-dir D]"
+                   " [--sweep-interval S] [--snapshot-every N] [--no-fsync]\n";
+      return 2;
+    }
+  }
+
+  ::signal(SIGINT, on_signal);
+  ::signal(SIGTERM, on_signal);
+  ::signal(SIGPIPE, SIG_IGN);
+
+  edl::Store store(data_dir, fsync, /*max_events=*/4096,
+                   static_cast<size_t>(snapshot_every));
+
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    std::cerr << "bad host " << host << "\n";
+    return 2;
+  }
+  if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    std::cerr << "bind failed: " << std::strerror(errno) << "\n";
+    return 1;
+  }
+  if (::listen(lfd, 128) != 0) {
+    std::cerr << "listen failed: " << std::strerror(errno) << "\n";
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::cerr << "edl-store listening on " << host << ":"
+            << ntohs(addr.sin_port)
+            << (data_dir.empty() ? " (ephemeral)" : " (durable: " + data_dir + ")")
+            << std::endl;
+
+  std::thread sweeper([&] {
+    while (!g_stop) {
+      std::this_thread::sleep_for(std::chrono::duration<double>(sweep_interval));
+      store.sweep();
+    }
+  });
+
+  // Accept loop with a timeout so SIGTERM is honored promptly.
+  timeval tv{0, 200000};
+  ::setsockopt(lfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  while (!g_stop) {
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) continue;
+      break;
+    }
+    std::thread(edl::serve_connection, &store, cfd).detach();
+  }
+  ::close(lfd);
+  sweeper.join();
+  return 0;
+}

@@ -1,0 +1,389 @@
+#include "store.hpp"
+
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <fstream>
+#include <tuple>
+
+namespace edl {
+
+namespace {
+std::string path_join(const std::string& dir, const char* name) {
+  return dir + "/" + name;
+}
+}  // namespace
+
+Store::Store(std::string data_dir, bool fsync, size_t max_events,
+             size_t snapshot_every)
+    : max_events_(max_events),
+      data_dir_(std::move(data_dir)),
+      fsync_(fsync),
+      snapshot_every_(snapshot_every) {
+  if (!data_dir_.empty()) {
+    ::mkdir(data_dir_.c_str(), 0755);  // EEXIST is fine
+    load();
+    wal_ = std::fopen(path_join(data_dir_, "wal.log").c_str(), "ab");
+    if (!wal_)
+      throw std::runtime_error("cannot open WAL: " +
+                               std::string(std::strerror(errno)));
+  }
+}
+
+Store::~Store() {
+  if (wal_) std::fclose(wal_);
+}
+
+// ---- unlocked internals ---------------------------------------------------
+
+void Store::emit(Event ev) {
+  events_.push_back(std::move(ev));
+  if (events_.size() > max_events_) {
+    size_t drop = events_.size() - max_events_;
+    first_event_rev_ = events_[drop].revision;
+    events_.erase(events_.begin(),
+                  events_.begin() + static_cast<ptrdiff_t>(drop));
+  }
+}
+
+void Store::expire() {
+  auto now = Clock::now();
+  std::vector<int64_t> dead;
+  for (auto& kv : leases_)
+    if (kv.second.deadline <= now) dead.push_back(kv.first);
+  for (int64_t id : dead) lease_revoke_unlocked(id, /*log=*/true);
+}
+
+void Store::check_lease(int64_t lease) {
+  if (lease != 0 && leases_.find(lease) == leases_.end())
+    throw LeaseExpiredError(lease);
+}
+
+void Store::detach(const std::string& key, const Record& rec) {
+  if (rec.lease != 0) {
+    auto it = leases_.find(rec.lease);
+    if (it != leases_.end()) it->second.keys.erase(key);
+  }
+}
+
+int64_t Store::put_unlocked(const std::string& key, const std::string& value,
+                            int64_t lease, bool log) {
+  check_lease(lease);
+  auto old = data_.find(key);
+  if (old != data_.end()) detach(key, old->second);
+  int64_t rev = bump();
+  data_[key] = Record{key, value, rev, lease};
+  if (lease != 0) leases_[lease].keys.insert(key);
+  emit(Event{"PUT", key, value, rev});
+  if (log)
+    wal_append(Json(JsonObject{{"o", Json("put")},
+                               {"k", Json(key)},
+                               {"v", Json(value)},
+                               {"l", Json(lease)}}));
+  return rev;
+}
+
+bool Store::del_unlocked(const std::string& key, bool log) {
+  auto it = data_.find(key);
+  if (it == data_.end()) return false;
+  Record rec = it->second;
+  data_.erase(it);
+  detach(key, rec);
+  emit(Event{"DELETE", key, rec.value, bump()});
+  if (log)
+    wal_append(Json(JsonObject{{"o", Json("del")}, {"k", Json(key)}}));
+  return true;
+}
+
+int64_t Store::lease_grant_unlocked(double ttl, int64_t forced_id, bool log) {
+  int64_t id = forced_id > 0 ? forced_id : next_lease_;
+  if (id >= next_lease_) next_lease_ = id + 1;
+  Lease lease;
+  lease.id = id;
+  lease.ttl = ttl;
+  lease.deadline =
+      Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                         std::chrono::duration<double>(ttl));
+  leases_[id] = std::move(lease);
+  if (log)
+    wal_append(Json(JsonObject{
+        {"o", Json("lg")}, {"id", Json(id)}, {"ttl", Json(ttl)}}));
+  return id;
+}
+
+bool Store::lease_revoke_unlocked(int64_t lease, bool log) {
+  auto it = leases_.find(lease);
+  if (it == leases_.end()) return false;
+  // Copy: del_unlocked detaches from the live set while we iterate.
+  std::set<std::string> keys = it->second.keys;
+  leases_.erase(it);
+  for (const auto& key : keys) {
+    auto rec = data_.find(key);
+    if (rec != data_.end()) {
+      Record copy = rec->second;
+      data_.erase(rec);
+      emit(Event{"DELETE", key, copy.value, bump()});
+    }
+  }
+  if (log)
+    wal_append(Json(JsonObject{{"o", Json("lr")}, {"id", Json(lease)}}));
+  return true;
+}
+
+// ---- public API -----------------------------------------------------------
+
+int64_t Store::put(const std::string& key, const std::string& value,
+                   int64_t lease) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  int64_t rev = put_unlocked(key, value, lease, /*log=*/true);
+  maybe_snapshot();
+  return rev;
+}
+
+std::optional<Record> Store::get(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  auto it = data_.find(key);
+  if (it == data_.end()) return std::nullopt;
+  return it->second;
+}
+
+std::pair<std::vector<Record>, int64_t> Store::get_prefix(
+    const std::string& prefix) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  std::vector<Record> out;
+  // std::map is key-ordered: range-scan from lower_bound.
+  for (auto it = data_.lower_bound(prefix); it != data_.end(); ++it) {
+    if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+    out.push_back(it->second);
+  }
+  return {out, revision_};
+}
+
+bool Store::del(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  bool deleted = del_unlocked(key, /*log=*/true);
+  maybe_snapshot();
+  return deleted;
+}
+
+int64_t Store::delete_prefix(const std::string& prefix) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  std::vector<std::string> keys;
+  for (auto it = data_.lower_bound(prefix); it != data_.end(); ++it) {
+    if (it->first.compare(0, prefix.size(), prefix) != 0) break;
+    keys.push_back(it->first);
+  }
+  for (const auto& key : keys) del_unlocked(key, /*log=*/true);
+  maybe_snapshot();
+  return static_cast<int64_t>(keys.size());
+}
+
+bool Store::put_if_absent(const std::string& key, const std::string& value,
+                          int64_t lease) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  if (data_.count(key)) return false;
+  check_lease(lease);
+  put_unlocked(key, value, lease, /*log=*/true);
+  maybe_snapshot();
+  return true;
+}
+
+bool Store::compare_and_swap(const std::string& key,
+                             const std::optional<std::string>& expect,
+                             const std::string& value, int64_t lease) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  auto cur = data_.find(key);
+  if (!expect.has_value()) {
+    if (cur != data_.end()) return false;
+  } else if (cur == data_.end() || cur->second.value != *expect) {
+    return false;
+  }
+  put_unlocked(key, value, lease, /*log=*/true);
+  maybe_snapshot();
+  return true;
+}
+
+int64_t Store::lease_grant(double ttl) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  int64_t id = lease_grant_unlocked(ttl, 0, /*log=*/true);
+  maybe_snapshot();
+  return id;
+}
+
+bool Store::lease_keepalive(int64_t lease) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  auto it = leases_.find(lease);
+  if (it == leases_.end()) return false;
+  it->second.deadline =
+      Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                         std::chrono::duration<double>(it->second.ttl));
+  // Keepalives are deliberately NOT logged: replayed leases restart with a
+  // full TTL anyway, and logging 1/s per lease would bloat the WAL.
+  return true;
+}
+
+bool Store::lease_revoke(int64_t lease) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  bool revoked = lease_revoke_unlocked(lease, /*log=*/true);
+  maybe_snapshot();
+  return revoked;
+}
+
+std::tuple<std::vector<Event>, int64_t, bool> Store::events_since(
+    int64_t revision, const std::string& prefix) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  if (revision + 1 < first_event_rev_) return {{}, revision_, true};
+  std::vector<Event> out;
+  for (const auto& ev : events_) {
+    if (ev.revision > revision &&
+        ev.key.compare(0, prefix.size(), prefix) == 0)
+      out.push_back(ev);
+  }
+  return {out, revision_, false};
+}
+
+void Store::sweep() {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+}
+
+// ---- persistence ----------------------------------------------------------
+
+void Store::wal_append(const Json& op) {
+  if (!wal_ || replaying_) return;
+  std::string line = op.dump();
+  line += '\n';
+  if (std::fwrite(line.data(), 1, line.size(), wal_) != line.size())
+    throw std::runtime_error("WAL write failed");
+  std::fflush(wal_);
+  if (fsync_) ::fdatasync(::fileno(wal_));
+  ++wal_lines_;
+}
+
+void Store::maybe_snapshot() {
+  if (!wal_ || replaying_ || wal_lines_ < snapshot_every_) return;
+  write_snapshot();
+}
+
+void Store::write_snapshot() {
+  // Snapshot = full dump + truncated WAL; tmp-then-rename atomicity (the
+  // same contract as checkpoints, doc/fault_tolerance.md style).
+  JsonArray recs;
+  for (const auto& kv : data_)
+    recs.push_back(Json(JsonArray{Json(kv.second.key), Json(kv.second.value),
+                                  Json(kv.second.revision),
+                                  Json(kv.second.lease)}));
+  JsonArray leases;
+  for (const auto& kv : leases_)
+    leases.push_back(
+        Json(JsonArray{Json(kv.second.id), Json(kv.second.ttl)}));
+  Json snap(JsonObject{{"revision", Json(revision_)},
+                       {"next_lease", Json(next_lease_)},
+                       {"records", Json(std::move(recs))},
+                       {"leases", Json(std::move(leases))}});
+  std::string tmp = path_join(data_dir_, "snapshot.json.tmp");
+  std::string final_path = path_join(data_dir_, "snapshot.json");
+  {
+    std::ofstream out(tmp, std::ios::trunc | std::ios::binary);
+    out << snap.dump();
+    out.flush();
+    if (!out) throw std::runtime_error("snapshot write failed");
+  }
+  if (std::rename(tmp.c_str(), final_path.c_str()) != 0)
+    throw std::runtime_error("snapshot rename failed");
+  if (wal_) std::fclose(wal_);
+  wal_ = std::fopen(path_join(data_dir_, "wal.log").c_str(), "wb");
+  if (!wal_) throw std::runtime_error("WAL reopen failed");
+  if (fsync_) ::fdatasync(::fileno(wal_));
+  wal_lines_ = 0;
+}
+
+void Store::load() {
+  replaying_ = true;
+  std::ifstream snap_in(path_join(data_dir_, "snapshot.json"),
+                        std::ios::binary);
+  if (snap_in) {
+    std::string text((std::istreambuf_iterator<char>(snap_in)),
+                     std::istreambuf_iterator<char>());
+    if (!text.empty()) {
+      Json snap = Json::parse(text);
+      revision_ = snap["revision"].as_int();
+      next_lease_ = snap["next_lease"].as_int(1);
+      for (const auto& lease : snap["leases"].as_array()) {
+        const auto& arr = lease.as_array();
+        lease_grant_unlocked(arr[1].as_double(), arr[0].as_int(),
+                             /*log=*/false);
+      }
+      for (const auto& rec : snap["records"].as_array()) {
+        const auto& arr = rec.as_array();
+        Record r{arr[0].as_string(), arr[1].as_string(), arr[2].as_int(),
+                 arr[3].as_int()};
+        if (r.lease != 0) {
+          // A record with a vanished lease is dropped (its lease died with
+          // the previous process; keeping it would fake liveness).
+          auto it = leases_.find(r.lease);
+          if (it == leases_.end()) continue;
+          it->second.keys.insert(r.key);
+        }
+        data_[r.key] = r;
+      }
+    }
+  }
+  std::ifstream wal_in(path_join(data_dir_, "wal.log"), std::ios::binary);
+  if (wal_in) {
+    std::string line;
+    while (std::getline(wal_in, line)) {
+      if (line.empty()) continue;
+      try {
+        replay_line(line);
+      } catch (const std::exception&) {
+        // Torn tail write (crash mid-append): stop replaying here.
+        break;
+      }
+    }
+  }
+  // Event history does not survive restarts; watchers see compacted=True
+  // and fall back to a full get_prefix (the documented contract).
+  first_event_rev_ = revision_ + 1;
+  events_.clear();
+  replaying_ = false;
+}
+
+void Store::replay_line(const std::string& line) {
+  Json op = Json::parse(line);
+  const std::string& kind = op["o"].as_string();
+  if (kind == "put") {
+    try {
+      put_unlocked(op["k"].as_string(), op["v"].as_string(),
+                   op["l"].as_int(), /*log=*/false);
+    } catch (const LeaseExpiredError&) {
+      // Lease was revoked later in the WAL than this put was written —
+      // impossible in order; but a lease dropped at snapshot load can
+      // orphan a put. Skip: the key would have died with the lease.
+    }
+  } else if (kind == "del") {
+    del_unlocked(op["k"].as_string(), /*log=*/false);
+  } else if (kind == "lg") {
+    lease_grant_unlocked(op["ttl"].as_double(), op["id"].as_int(),
+                         /*log=*/false);
+  } else if (kind == "lr") {
+    lease_revoke_unlocked(op["id"].as_int(), /*log=*/false);
+  } else {
+    throw std::runtime_error("unknown WAL op: " + kind);
+  }
+}
+
+}  // namespace edl

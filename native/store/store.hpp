@@ -1,0 +1,123 @@
+// In-memory coordination store engine with WAL+snapshot durability.
+//
+// Semantics mirror the Python InMemStore (edl_tpu/coord/store.py) exactly:
+// global revision, TTL leases with lazy expiry + sweeper, bounded event
+// history with compaction, CAS/put-if-absent primitives. The native daemon
+// is the production flavor standing in for the reference's external etcd
+// dependency (docker/Dockerfile:28-30) and the Go master's etcd state store
+// (pkg/master/etcd_client.go:49-176) — with its own durability (WAL +
+// snapshot) so a coordinator restart does not kill the job.
+
+#pragma once
+
+#include <chrono>
+#include <cstdio>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <set>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "json.hpp"
+
+namespace edl {
+
+struct Record {
+  std::string key;
+  std::string value;
+  int64_t revision = 0;
+  int64_t lease = 0;
+};
+
+struct Event {
+  std::string type;  // "PUT" | "DELETE"
+  std::string key;
+  std::string value;
+  int64_t revision = 0;
+};
+
+// Server-side typed error; the name prefix crosses the wire and is
+// re-hydrated by the Python client (coord/client.py _typed_error).
+struct LeaseExpiredError : std::runtime_error {
+  explicit LeaseExpiredError(int64_t lease)
+      : std::runtime_error("lease " + std::to_string(lease) +
+                           " unknown or expired") {}
+};
+
+class Store {
+ public:
+  using Clock = std::chrono::steady_clock;
+
+  // data_dir == "" -> ephemeral (no persistence).
+  explicit Store(std::string data_dir = "", bool fsync = true,
+                 size_t max_events = 4096, size_t snapshot_every = 8192);
+  ~Store();
+
+  int64_t put(const std::string& key, const std::string& value,
+              int64_t lease);
+  std::optional<Record> get(const std::string& key);
+  std::pair<std::vector<Record>, int64_t> get_prefix(
+      const std::string& prefix);
+  bool del(const std::string& key);
+  int64_t delete_prefix(const std::string& prefix);
+  bool put_if_absent(const std::string& key, const std::string& value,
+                     int64_t lease);
+  // expect==nullopt -> key must be absent (mirrors Python expect=None).
+  bool compare_and_swap(const std::string& key,
+                        const std::optional<std::string>& expect,
+                        const std::string& value, int64_t lease);
+  int64_t lease_grant(double ttl);
+  bool lease_keepalive(int64_t lease);
+  bool lease_revoke(int64_t lease);
+  // returns (events, current_revision, compacted)
+  std::tuple<std::vector<Event>, int64_t, bool> events_since(
+      int64_t revision, const std::string& prefix);
+  void sweep();
+
+ private:
+  struct Lease {
+    int64_t id = 0;
+    double ttl = 0.0;
+    Clock::time_point deadline;
+    std::set<std::string> keys;
+  };
+
+  // unlocked internals ------------------------------------------------
+  int64_t bump() { return ++revision_; }
+  void emit(Event ev);
+  void expire();
+  void check_lease(int64_t lease);
+  void detach(const std::string& key, const Record& rec);
+  int64_t put_unlocked(const std::string& key, const std::string& value,
+                       int64_t lease, bool log);
+  bool del_unlocked(const std::string& key, bool log);
+  int64_t lease_grant_unlocked(double ttl, int64_t forced_id, bool log);
+  bool lease_revoke_unlocked(int64_t lease, bool log);
+
+  // persistence -------------------------------------------------------
+  void wal_append(const Json& op);
+  void load();
+  void replay_line(const std::string& line);
+  void maybe_snapshot();  // caller holds mutex
+  void write_snapshot();
+
+  std::mutex mu_;
+  std::map<std::string, Record> data_;
+  std::map<int64_t, Lease> leases_;
+  int64_t revision_ = 0;
+  int64_t next_lease_ = 1;
+  std::vector<Event> events_;
+  size_t max_events_;
+  int64_t first_event_rev_ = 1;
+
+  std::string data_dir_;
+  bool fsync_ = true;
+  size_t snapshot_every_;
+  size_t wal_lines_ = 0;
+  std::FILE* wal_ = nullptr;
+  bool replaying_ = false;
+};
+
+}  // namespace edl

@@ -1,0 +1,223 @@
+"""The C++ edl-store daemon must be protocol- and semantics-identical to
+the Python server: the same StoreClient + registry + barrier flows run
+against it, plus what only it provides — WAL/snapshot durability across a
+SIGKILL.
+
+(The SURVEY §2.2 native contract: the Go master's etcd state store role,
+pkg/master/etcd_client.go:49-176, filled by a C++ daemon.)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import time
+
+import pytest
+
+from edl_tpu.collective import barrier as bar
+from edl_tpu.collective import register as reg
+from edl_tpu.collective.cluster import Pod
+from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.registry import ServiceRegistry
+from edl_tpu.utils import net
+from edl_tpu.utils.exceptions import EdlLeaseExpired
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native", "store")
+BINARY = os.path.join(NATIVE_DIR, "edl-store")
+
+
+@pytest.fixture(scope="session")
+def binary():
+    build = subprocess.run(["make", "-C", NATIVE_DIR], capture_output=True,
+                           text=True)
+    assert build.returncode == 0, f"native build failed:\n{build.stderr}"
+    return BINARY
+
+
+def start_daemon(binary, tmp_path, *, data_dir=None, port=None,
+                 extra=()):
+    port = port or net.free_port()
+    cmd = [binary, "--host", "127.0.0.1", "--port", str(port),
+           "--sweep-interval", "0.05", *extra]
+    if data_dir is not None:
+        cmd += ["--data-dir", str(data_dir)]
+    proc = subprocess.Popen(cmd, stdout=open(tmp_path / "native.log", "ab"),
+                            stderr=subprocess.STDOUT)
+    client = StoreClient(f"127.0.0.1:{port}", timeout=5.0)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if client.ping():
+            return proc, client, port
+        time.sleep(0.1)
+    proc.kill()
+    pytest.fail("edl-store never came up")
+
+
+@pytest.fixture
+def daemon(binary, tmp_path):
+    proc, client, port = start_daemon(binary, tmp_path)
+    yield client
+    client.close()
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_roundtrip_and_prefix(daemon):
+    r1 = daemon.put("/a/x", "1")
+    r2 = daemon.put("/a/y", "2")
+    daemon.put("/b/z", "3")
+    assert r2 == r1 + 1
+    assert daemon.get("/a/x").value == "1"
+    recs, rev = daemon.get_prefix("/a/")
+    assert [(r.key, r.value) for r in recs] == [("/a/x", "1"), ("/a/y", "2")]
+    assert rev >= r2
+    assert daemon.delete("/a/x")
+    assert not daemon.delete("/a/x")
+    assert daemon.delete_prefix("/a/") == 1
+    assert daemon.get_prefix("/a/")[0] == []
+
+
+def test_unicode_and_json_values(daemon):
+    # Pod records are JSON-in-JSON with quotes/escapes; registry info may
+    # carry non-ASCII.
+    value = json.dumps({"pod": 'quote"backslash\\', "emoji": "é中"})
+    daemon.put("/u", value)
+    assert daemon.get("/u").value == value
+    daemon.put("/u2", "line\nbreak\ttab\x01ctl")
+    assert daemon.get("/u2").value == "line\nbreak\ttab\x01ctl"
+
+
+def test_cas_and_put_if_absent(daemon):
+    assert daemon.put_if_absent("/k", "a")
+    assert not daemon.put_if_absent("/k", "b")
+    assert daemon.get("/k").value == "a"
+    assert not daemon.compare_and_swap("/k", "wrong", "c")
+    assert daemon.compare_and_swap("/k", "a", "c")
+    assert daemon.get("/k").value == "c"
+    # expect=None means "must be absent".
+    assert not daemon.compare_and_swap("/k", None, "d")
+    assert daemon.compare_and_swap("/new", None, "d")
+
+
+def test_lease_expiry_emits_delete_events(daemon):
+    lease = daemon.lease_grant(0.3)
+    daemon.put("/leased", "v", lease=lease)
+    _, rev, _ = daemon.events_since(0)
+    time.sleep(0.8)   # sweeper interval 0.05 + ttl
+    assert daemon.get("/leased") is None
+    events, _, compacted = daemon.events_since(rev - 1)
+    assert not compacted
+    assert any(e.type == "DELETE" and e.key == "/leased" for e in events)
+
+
+def test_lease_keepalive_extends(daemon):
+    lease = daemon.lease_grant(0.4)
+    daemon.put("/ka", "v", lease=lease)
+    for _ in range(5):
+        time.sleep(0.2)
+        assert daemon.lease_keepalive(lease)
+    assert daemon.get("/ka").value == "v"
+    assert daemon.lease_revoke(lease)
+    assert daemon.get("/ka") is None
+
+
+def test_typed_lease_error_over_wire(daemon):
+    lease = daemon.lease_grant(5.0)
+    daemon.lease_revoke(lease)
+    with pytest.raises(EdlLeaseExpired):
+        daemon.put("/dead", "1", lease=lease)
+
+
+def test_registry_and_barrier_flows(daemon):
+    # The launcher-critical paths: service registration + rank claim +
+    # leader-published cluster barrier, all through the native daemon.
+    registry = ServiceRegistry(daemon, root="edl_distill")
+    registration = registry.register("svc", "127.0.0.1:9000", ttl=2.0)
+    assert [m.server for m in registry.get_service("svc")] \
+        == ["127.0.0.1:9000"]
+
+    regs = []
+    for i in range(2):
+        pod = Pod(pod_id=f"pod{i}", addr="127.0.0.1", port=21000 + i)
+        r = reg.PodRegister(daemon, "njob", pod, ttl=2.0)
+        r.claim()
+        regs.append(r)
+    cluster = bar.cluster_barrier(daemon, "njob", "pod0", stable_secs=0.2,
+                                  timeout=15.0)
+    assert cluster.world_size == 2 and cluster.version == 1
+    regs[1].release()
+    c2 = bar.cluster_barrier(daemon, "njob", "pod0", after_version=1,
+                             stable_secs=0.2, timeout=15.0)
+    assert c2.version == 2 and c2.pod_ids() == {"pod0"}
+    regs[0].release()
+    registration.stop()
+
+
+def test_durability_across_sigkill(binary, tmp_path):
+    data_dir = tmp_path / "store-data"
+    proc, client, port = start_daemon(binary, tmp_path, data_dir=data_dir)
+    try:
+        client.put("/persist/a", "1")
+        client.put("/persist/b", "2")
+        lease = client.lease_grant(1.0)
+        client.put("/ephemeral", "x", lease=lease)
+        rev_before = client.get("/persist/b").revision
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)   # no graceful flush
+        proc.wait(timeout=5)
+        client.close()
+
+    proc2, client2, _ = start_daemon(binary, tmp_path, data_dir=data_dir,
+                                     port=port)
+    try:
+        assert client2.get("/persist/a").value == "1"
+        assert client2.get("/persist/b").value == "2"
+        assert client2.get("/persist/b").revision == rev_before
+        # Leased key comes back under a grace TTL, then expires (its owner
+        # died with the old process and nobody keeps it alive).
+        time.sleep(2.0)
+        assert client2.get("/ephemeral") is None
+        # New mutations take revisions after the replayed history.
+        assert client2.put("/persist/c", "3") > rev_before
+    finally:
+        proc2.terminate()
+        proc2.wait(timeout=5)
+        client2.close()
+
+
+def test_snapshot_compaction_and_restart(binary, tmp_path):
+    data_dir = tmp_path / "snap-data"
+    proc, client, port = start_daemon(
+        binary, tmp_path, data_dir=data_dir,
+        extra=("--snapshot-every", "50", "--no-fsync"))
+    try:
+        for i in range(120):   # crosses 2 snapshot thresholds
+            client.put(f"/k/{i:04d}", str(i))
+        client.delete_prefix("/k/000")   # deletes 0000..0009
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+        client.close()
+    assert (data_dir / "snapshot.json").exists()
+
+    proc2, client2, _ = start_daemon(binary, tmp_path, data_dir=data_dir,
+                                     port=port)
+    try:
+        recs, _ = client2.get_prefix("/k/")
+        assert len(recs) == 110
+        assert client2.get("/k/0119").value == "119"
+        assert client2.get("/k/0005") is None
+    finally:
+        proc2.terminate()
+        proc2.wait(timeout=5)
+        client2.close()
+
+
+def test_garbage_bytes_close_connection_not_daemon(daemon):
+    import socket
+    host, port = daemon._endpoint.split(":")
+    s = socket.create_connection((host, int(port)), timeout=3)
+    s.sendall(b"NOT-A-FRAME" * 100)
+    s.close()
+    assert daemon.ping()
