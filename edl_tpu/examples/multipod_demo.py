@@ -1,0 +1,130 @@
+"""One-world multi-pod data-parallel trainer — the flagship trainer path.
+
+The capability the reference reaches through `fleet.init(is_collective=True)`
+(example/collective/resnet50/train_with_fleet.py:376-377 — every trainer
+joins ONE NCCL world formed from the PADDLE_TRAINER_* env the launcher
+exported, collective/launch.py:163-194): here each launcher-spawned trainer
+calls `init_from_env()`, which joins the `jax.distributed` world at the
+rank-0 pod's coordinator endpoint; a single `dp` mesh then spans every
+pod's devices and one jitted train step carries the gradient all-reduce —
+XLA compiles it over ICI/DCN (gloo on CPU test worlds).
+
+Determinism contract (what makes elastic resize testable): the data stream
+is a function of (epoch, global batch size) ONLY — each process feeds its
+rank's slice of the same global batch — so a run resized N->M pods produces
+bit-comparable parameters to an unresized run, modulo reduction order.
+
+  launcher: python -m edl_tpu.collective.launch --store HOST:PORT -- \
+      python -m edl_tpu.examples.multipod_demo --epochs 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from edl_tpu.models.linear import LinearRegression, mse_loss
+from edl_tpu.parallel import distributed, mesh as mesh_lib
+from edl_tpu.train.loop import LoopConfig, TrainLoop
+from edl_tpu.train.state import TrainState
+from edl_tpu.train.step import make_train_step
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.examples.multipod_demo")
+
+TRUE_W, TRUE_B = 3.0, -1.5
+
+
+def make_global_data(epoch: int, steps: int, global_batch: int):
+    """The full epoch stream, identical on every process (seed-per-pass)."""
+    rng = np.random.default_rng(7000 + epoch)
+    n = steps * global_batch
+    x = rng.normal(size=(n, 1)).astype(np.float32)
+    y = (TRUE_W * x + TRUE_B
+         + 0.01 * rng.normal(size=(n, 1)).astype(np.float32))
+    for i in range(steps):
+        s = slice(i * global_batch, (i + 1) * global_batch)
+        yield {"x": x[s], "y": y[s]}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=5)
+    parser.add_argument("--steps-per-epoch", type=int, default=20)
+    parser.add_argument("--global-batch", type=int, default=32)
+    parser.add_argument("--step-time", type=float, default=0.0,
+                        help="artificial per-step delay (resize-window test)")
+    parser.add_argument("--out", default="",
+                        help="rank 0 writes final params JSON here")
+    args = parser.parse_args(argv)
+
+    distributed.force_platform_from_env()  # before any backend init
+    env = distributed.init_from_env()  # forms the world iff world_size > 1
+    world = max(1, env.world_size)
+    if args.global_batch % world:
+        raise SystemExit(f"global batch {args.global_batch} not divisible "
+                         f"by world size {world}")
+    local_bs = args.global_batch // world
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": -1}))
+    log.info("trainer up: rank=%d world=%d devices=%d cluster_v=%d",
+             env.rank, world, jax.device_count(), env.cluster_version)
+
+    model = LinearRegression(features=1)
+    tx = optax.sgd(0.05)
+    replicated = mesh_lib.replicated(mesh)
+
+    def build_state():
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 1)))["params"]
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    # Params materialize directly as global replicated arrays — in a
+    # multi-process world host-created state can't feed a global-mesh jit.
+    state = jax.jit(build_state, out_shardings=replicated)()
+
+    def loss_fn(state, params, batch):
+        pred = state.apply_fn({"params": params}, batch["x"])
+        return mse_loss(pred, batch["y"]), {}
+
+    step = make_train_step(loss_fn, donate=False)
+    if args.step_time > 0:
+        import time
+        raw_step = step
+
+        def step(s, b):  # noqa: F811 — wrapped for the resize-window test
+            time.sleep(args.step_time)
+            return raw_step(s, b)
+
+    def data_fn(epoch):
+        for g in make_global_data(epoch, args.steps_per_epoch,
+                                  args.global_batch):
+            lo = env.rank * local_bs
+            local = {k: v[lo:lo + local_bs] for k, v in g.items()}
+            yield mesh_lib.form_global_batch(mesh, local)
+
+    loop = TrainLoop(step, state, config=LoopConfig(
+        num_epochs=args.epochs,
+        ckpt_dir=env.checkpoint_path or None,
+        log_every_steps=args.steps_per_epoch),
+        place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t))
+    status = loop.run(data_fn)
+
+    w = float(np.asarray(loop.state.params["Dense_0"]["kernel"])[0, 0])
+    b = float(np.asarray(loop.state.params["Dense_0"]["bias"])[0])
+    log.info("done: epoch=%d step=%d w=%.5f b=%.5f", status.epoch,
+             status.step, w, b)
+    if args.out and jax.process_index() == 0:
+        with open(args.out, "w") as f:
+            json.dump({"w": w, "b": b, "epoch": status.epoch,
+                       "step": status.step, "world": world}, f)
+    distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

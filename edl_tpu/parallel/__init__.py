@@ -2,9 +2,12 @@ from edl_tpu.parallel.mesh import (
     MeshSpec,
     make_mesh,
     data_sharding,
+    form_global_batch,
+    replicate_host_tree,
     replicated,
     shard_batch,
 )
+from edl_tpu.parallel.distributed import init_from_env
 from edl_tpu.parallel.sharding import (
     DEFAULT_RULES,
     constrain,
@@ -18,6 +21,9 @@ __all__ = [
     "MeshSpec",
     "make_mesh",
     "data_sharding",
+    "form_global_batch",
+    "init_from_env",
+    "replicate_host_tree",
     "replicated",
     "shard_batch",
     "DEFAULT_RULES",

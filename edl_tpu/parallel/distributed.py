@@ -3,14 +3,21 @@
 Replaces the reference's NCCL world bootstrap (Paddle fleet reads
 PADDLE_TRAINER_* env and broadcasts ncclUniqueId over sockets,
 utils/edl_process.py:42-47): a trainer started by
-`edl_tpu.collective.launch` calls `init_from_env()` once; on a multi-pod
-cluster this runs `jax.distributed.initialize` against the rank-0 pod's
-coordinator endpoint, after which `jax.devices()` spans all hosts and every
-mesh built on it gets its collectives compiled over ICI/DCN by XLA — there
-is no per-op communication library to configure.
+`edl_tpu.collective.launch` calls `init_from_env()` once (e.g.
+`examples/multipod_demo.py`, the launcher's one-world trainer); on a
+multi-pod cluster this runs `jax.distributed.initialize` against the
+rank-0 pod's coordinator endpoint, after which `jax.devices()` spans all
+hosts and every mesh built on it gets its collectives compiled over
+ICI/DCN by XLA — there is no per-op communication library to configure.
+
+On CPU (tests/CI) the cross-process data plane is the gloo TCP
+collectives backend, selected automatically; on TPU, ICI/DCN needs no
+selection.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 
@@ -22,12 +29,42 @@ log = get_logger("edl_tpu.parallel.distributed")
 _initialized = False
 
 
+def force_platform_from_env() -> None:
+    """Apply JAX_PLATFORMS / JAX_NUM_CPU_DEVICES programmatically.
+
+    Some environments (device-tunnel plugins registered from
+    sitecustomize) override env-var platform selection, so a trainer that
+    must run on host CPUs (tests, CI) applies the same contract through
+    jax.config before the backend initializes. No-op once a backend
+    exists or when the vars are unset.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    ndev = os.environ.get("JAX_NUM_CPU_DEVICES", "").strip()
+    try:
+        ndev_i = int(ndev) if ndev else None
+    except ValueError:
+        log.warning("ignoring malformed JAX_NUM_CPU_DEVICES=%r", ndev)
+        ndev_i = None
+    try:
+        if plat:
+            jax.config.update("jax_platforms", plat)
+        if ndev_i is not None:
+            jax.config.update("jax_num_cpu_devices", ndev_i)
+    except RuntimeError:  # backend already up — leave it be
+        pass
+
+
 def init_from_env(env: TrainerEnv | None = None) -> TrainerEnv:
     """Join the multi-host world described by the EDL_TPU_* env (no-op for
     single-pod jobs or repeat calls). Returns the parsed TrainerEnv."""
     global _initialized
     env = env or TrainerEnv.from_environ()
     if env.world_size > 1 and not _initialized:
+        force_platform_from_env()
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # Multi-process CPU needs an explicit inter-process collectives
+            # implementation; TPU rides ICI/DCN without one.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         log.info("joining world: rank=%d/%d coordinator=%s",
                  env.rank, env.world_size, env.coordinator)
         jax.distributed.initialize(
@@ -36,6 +73,10 @@ def init_from_env(env: TrainerEnv | None = None) -> TrainerEnv:
             process_id=env.rank)
         _initialized = True
     return env
+
+
+def is_initialized() -> bool:
+    return _initialized
 
 
 def shutdown() -> None:

@@ -86,6 +86,45 @@ def shard_batch(mesh: Mesh, batch, batch_axes: tuple[str, ...] | None = None):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
+def form_global_batch(mesh: Mesh, local_batch,
+                      batch_axes: tuple[str, ...] | None = None):
+    """Assemble per-process host shards into one global device batch.
+
+    Multi-host analogue of `shard_batch` (to which it degenerates in a
+    single-process world): each process passes its own contiguous slice of
+    the global batch (dim 0, ordered by process index) and gets back a
+    global `jax.Array` sharded over the mesh's data axes — the input-feed
+    half of the one-world contract the reference delegates to per-trainer
+    data shards feeding per-GPU NCCL ranks.
+    """
+    if jax.process_count() == 1:
+        return shard_batch(mesh, local_batch, batch_axes)
+    sharding = data_sharding(mesh, batch_axes)
+    if sharding.is_fully_replicated:
+        # No data axes in the mesh: every process must hold the full batch,
+        # so "local slice x nproc" arithmetic does not apply.
+        return replicate_host_tree(mesh, local_batch)
+    nproc = jax.process_count()
+
+    def place(x):
+        x = np.asarray(x)
+        global_shape = (x.shape[0] * nproc,) + x.shape[1:]
+        return jax.make_array_from_process_local_data(
+            sharding, x, global_shape)
+
+    return jax.tree.map(place, local_batch)
+
+
+def replicate_host_tree(mesh: Mesh, tree):
+    """Place an identical-on-every-process host pytree replicated on mesh.
+
+    The restore half of multi-host checkpointing: every process
+    deserializes the same host state, then re-places it as one global
+    replicated array so a following jitted step sees committed global
+    inputs (works on any process count; device_put handles both)."""
+    return shard_batch(mesh, tree, batch_axes=())
+
+
 def dp_size(mesh: Mesh) -> int:
     size = 1
     for axis in ("dp", "fsdp"):

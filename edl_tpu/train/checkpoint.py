@@ -12,11 +12,17 @@ doc/fault_tolerance.md and train_with_fleet.py:422-434,562-570):
   shaped at save time;
 - keep the newest ``max_to_keep`` checkpoints.
 
-State payload is a flax-serialized msgpack of the TrainState pytree (fully
-addressable values are gathered to host; on elastic resize the loaded host
-arrays are simply re-placed onto the new mesh — data-parallel state is
-replicated so resharding is trivial; sharded states re-place per the
-sharding rules in parallel/sharding.py).
+Two state-payload formats behind one manager:
+
+- replicated (default): a flax msgpack of the host-gathered pytree,
+  written by rank 0 — right for data-parallel states, where every value
+  is fully addressable and resharding is trivial re-placement;
+- sharded (``sharded=True``): every process writes only its own array
+  chunks + an index (train/sharded_checkpoint.py), and restore
+  re-assembles each leaf onto the TARGET state's shardings — including a
+  different mesh shape/device count — without ever materializing a full
+  replica on host. ``restore`` auto-detects which format a version holds,
+  so an elastic restart can move between formats.
 """
 
 from __future__ import annotations
@@ -31,6 +37,7 @@ from typing import Any
 import jax
 from flax import serialization
 
+from edl_tpu.train import sharded_checkpoint as sc
 from edl_tpu.train.state import TrainStatus
 from edl_tpu.utils.logging import get_logger
 
@@ -41,10 +48,11 @@ _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
 
 class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 process_index: int | None = None):
+                 process_index: int | None = None, sharded: bool = False):
         self.directory = directory
         self.max_to_keep = max_to_keep
         self._process_index = process_index
+        self.sharded = sharded
 
     @property
     def process_index(self) -> int:
@@ -74,7 +82,15 @@ class CheckpointManager:
     # -- save --------------------------------------------------------------
 
     def save(self, state: Any, status: TrainStatus) -> int | None:
-        """Save a new checkpoint; returns its version (None on non-rank-0)."""
+        """Save a new checkpoint; returns its version (None on non-writers).
+
+        Replicated mode: rank 0 does everything. Sharded mode: every
+        process writes its chunks into the same pending dir (all callers
+        of the world must call save together), then rank 0 seals it with
+        meta.json + atomic rename after a world barrier.
+        """
+        if self.sharded:
+            return self._save_sharded(state, status)
         if self.process_index != 0:
             return None
         latest = self.latest_version()
@@ -97,6 +113,71 @@ class CheckpointManager:
         self._gc()
         return version
 
+    def _sync(self, tag: str) -> None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(tag)
+
+    def _save_sharded(self, state: Any, status: TrainStatus) -> int | None:
+        # All processes agree on the version: the barrier orders this
+        # listing after every process finished (and rank 0 sealed) any
+        # previous save.
+        self._sync("edl_ckpt_begin")
+        latest = self.latest_version()
+        version = 0 if latest is None else latest + 1
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = os.path.join(self.directory, f".tmp-ckpt-{version}")
+        # A crashed earlier save may have left stale chunks/indexes under
+        # the same deterministic name (possibly from a different world
+        # shape); sealing them in would corrupt the restore, so rank 0
+        # clears the dir before anyone writes.
+        if self.process_index == 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+        self._sync("edl_ckpt_clean")
+        # A process that fails mid-write must still reach the barrier
+        # (otherwise the healthy ranks hang in it until the coordination
+        # timeout); it drops a poison marker so every rank raises after.
+        failure: BaseException | None = None
+        try:
+            sc.save_sharded(tmp, state)
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            failure = exc
+            try:
+                os.makedirs(tmp, exist_ok=True)
+                with open(os.path.join(
+                        tmp, f"save_failed.{self.process_index}"), "w"):
+                    pass
+            except OSError:
+                pass
+        self._sync("edl_ckpt_chunks")
+        poisoned = [n for n in (os.listdir(tmp) if os.path.isdir(tmp) else [])
+                    if n.startswith("save_failed.")]
+        if failure is not None or poisoned:
+            if self.process_index == 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+            if failure is not None:
+                raise failure
+            raise RuntimeError(
+                f"sharded save aborted: {poisoned} failed")
+        try:
+            if self.process_index == 0:
+                meta = {"version": version, "status": status.to_dict(),
+                        "format": "sharded",
+                        "world": {"process_count": jax.process_count(),
+                                  "device_count": jax.device_count()}}
+                with open(os.path.join(tmp, "meta.json"), "w") as f:
+                    json.dump(meta, f)
+                os.rename(tmp, self._path(version))
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        if self.process_index != 0:
+            return None
+        log.info("saved sharded checkpoint %s (epoch=%d step=%d)",
+                 self._path(version), status.epoch, status.step)
+        self._gc()
+        return version
+
     def _gc(self) -> None:
         versions = self.versions()
         for version in versions[: max(0, len(versions) - self.max_to_keep)]:
@@ -111,14 +192,23 @@ class CheckpointManager:
 
     def restore(self, target: Any, version: int | None = None
                 ) -> tuple[Any, TrainStatus] | None:
-        """Restore into the structure of ``target``; None if no checkpoint."""
+        """Restore into the structure of ``target``; None if no checkpoint.
+
+        Auto-detects the version's format. Sharded checkpoints re-place
+        each leaf per ``target``'s shardings (so pass the new world's
+        freshly built state — any mesh shape); replicated checkpoints
+        deserialize to host numpy in ``target``'s structure.
+        """
         if version is None:
             version = self.latest_version()
         if version is None:
             return None
         path = self._path(version)
-        with open(os.path.join(path, "state.msgpack"), "rb") as f:
-            state = serialization.from_bytes(target, f.read())
+        if sc.is_sharded_dir(path):
+            state = sc.restore_sharded(path, target)
+        else:
+            with open(os.path.join(path, "state.msgpack"), "rb") as f:
+                state = serialization.from_bytes(target, f.read())
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         status = TrainStatus.from_dict(meta["status"])

@@ -35,6 +35,9 @@ class LoopConfig:
     ckpt_every_epochs: int = field(1, env="EDL_TPU_SAVE_CHECKPOINT_INTER")
     ckpt_every_steps: int = field(0, env="EDL_TPU_SAVE_CHECKPOINT_STEPS")
     ckpt_max_to_keep: int = field(3, env="EDL_TPU_CHECKPOINT_KEEP")
+    # Sharded (per-process chunk) checkpoints — required once params are
+    # fsdp/tp-sharded; replicated msgpack is the small-model default.
+    ckpt_sharded: bool = field(False, env="EDL_TPU_CHECKPOINT_SHARDED")
 
 
 class TrainLoop:
@@ -53,10 +56,16 @@ class TrainLoop:
                  mesh=None, config: LoopConfig | None = None,
                  eval_fn: Callable | None = None,
                  hooks: list[Callable] | None = None,
-                 batch_axes: tuple[str, ...] | None = None):
+                 batch_axes: tuple[str, ...] | None = None,
+                 place_state: Callable | None = None):
         self.step_fn = step_fn
         self.state = state
         self.mesh = mesh
+        # Re-places a restored host-side state pytree onto devices (required
+        # in a multi-process world where host numpy can't feed a global-mesh
+        # jit directly — e.g. mesh_lib.replicate_host_tree, or a sharded
+        # checkpoint's re-placement rules).
+        self.place_state = place_state
         self.config = config or LoopConfig()
         self.eval_fn = eval_fn
         self.hooks = hooks or []
@@ -65,7 +74,8 @@ class TrainLoop:
             world_size=mesh_lib.dp_size(mesh) if mesh is not None
             else jax.device_count())
         self.ckpt = (CheckpointManager(self.config.ckpt_dir,
-                                       self.config.ckpt_max_to_keep)
+                                       self.config.ckpt_max_to_keep,
+                                       sharded=self.config.ckpt_sharded)
                      if self.config.ckpt_dir else None)
         self.last_metrics: dict = {}
         # World size recorded in the restored checkpoint, set by
@@ -82,6 +92,8 @@ class TrainLoop:
         if restored is None:
             return False
         self.state, self.status = restored
+        if self.place_state is not None:
+            self.state = self.place_state(self.state)
         # Preserve the save-time world size (the resharding/LR-rescale hint)
         # before stamping the current world for the next save.
         self.saved_world_size = self.status.world_size
@@ -99,7 +111,10 @@ class TrainLoop:
     def _place(self, batch):
         if self.mesh is None:
             return batch
-        return mesh_lib.shard_batch(self.mesh, batch, self.batch_axes)
+        # form_global_batch degenerates to shard_batch in a single-process
+        # world; in a multi-process world it treats the fed batch as this
+        # process's slice of the global batch (multipod contract).
+        return mesh_lib.form_global_batch(self.mesh, batch, self.batch_axes)
 
     def run(self, data_fn: Callable[[int], Iterable],
             batch_size_fn: Callable[[Any], int] | None = None) -> TrainStatus:
