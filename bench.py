@@ -1,13 +1,18 @@
-"""Headline benchmark: ResNet50_vd training throughput (img/s).
+"""Headline benchmark suite: ResNet train, distill e2e, transformer MFU.
 
-Mirrors the reference's headline number — ResNet50_vd ImageNet training at
-1828 img/s on 8x V100 (README.md:70), i.e. 228.5 img/s per accelerator.
-This harness times the jitted bf16 training step (label smoothing 0.1, SGD
-momentum, the reference recipe's loss path) on the available TPU chip(s)
-and reports aggregate img/s; `vs_baseline` is per-accelerator throughput
-relative to the reference's per-V100 number.
+Mirrors the reference's published numbers (README.md:70-72):
+  - 1828 img/s ResNet50_vd pure training on 8x V100 (228.5/accelerator)
+    -> `resnet50_vd_train_imgs_per_sec` (the headline metric + vs_baseline),
+    fed through the real input pipeline (DataLoader + prefetch_to_device),
+  - 656 img/s co-located distill on the same 8 GPUs (82/accelerator)
+    -> `extras.distill_student_imgs_per_sec`: student train step + teacher
+    inference sharing this chip, logits over the real TCP tensor wire
+    through DistillReader (exactly-once pipeline, request coalescing),
+  - plus a net-new transformer LM number (no reference counterpart — its
+    models are CNNs): `extras.transformer_tokens_per_sec` and
+    `extras.transformer_mfu` against the chip's peak bf16 FLOPs.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extras"}.
 """
 
 from __future__ import annotations
@@ -17,13 +22,30 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
+# Peak dense bf16 FLOPs/s per chip by device kind (public spec sheets;
+# conservative default if the kind is unknown).
+PEAK_BF16 = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+}
 
-def main() -> None:
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
 
+def _sync(x) -> float:
+    # value fetch = hard sync (block_until_ready alone does not force
+    # execution through remote-device tunnels)
+    return float(x)
+
+
+def bench_resnet_dataloader(on_tpu: bool) -> dict:
+    """ResNet50_vd training fed by DataLoader + prefetch_to_device."""
+    from edl_tpu.data.pipeline import (ArraySource, DataLoader,
+                                       prefetch_to_device, random_flip_lr)
     from edl_tpu.models.resnet import ResNet50_vd, ResNetTiny
     from edl_tpu.parallel import mesh as mesh_lib
     from edl_tpu.train import classification as cls
@@ -31,43 +53,248 @@ def main() -> None:
     n_dev = len(jax.devices())
     if on_tpu:
         model = ResNet50_vd(num_classes=1000, dtype=jnp.bfloat16)
-        per_dev_batch, hw, classes, steps = 128, 224, 1000, 30
-    else:  # CPU smoke mode so the harness is testable anywhere
+        per_dev_batch, hw, classes, steps = 128, 224, 1000, 24
+        source_n = 512
+    else:
         model = ResNetTiny(num_classes=10, dtype=jnp.float32)
         per_dev_batch, hw, classes, steps = 8, 32, 10, 4
+        source_n = 32 * len(jax.devices())
 
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": n_dev}))
     batch_size = per_dev_batch * n_dev
+    rng = np.random.default_rng(0)
+    source = ArraySource({
+        "image": rng.normal(size=(source_n, hw, hw, 3)).astype(np.float32),
+        "label": rng.integers(0, classes, size=(source_n,)).astype(np.int32),
+    })
+    loader = DataLoader(source, batch_size, transforms=(random_flip_lr,))
+    sharding = mesh_lib.data_sharding(mesh)
+
     state = cls.create_state(model, jax.random.PRNGKey(0), (1, hw, hw, 3),
                              optax.sgd(0.1, momentum=0.9, nesterov=True))
     step = cls.make_classification_step(classes, smoothing=0.1, donate=True)
 
-    batch = mesh_lib.shard_batch(mesh, {
-        "image": jax.random.normal(jax.random.PRNGKey(1),
-                                   (batch_size, hw, hw, 3), jnp.float32),
-        "label": jax.random.randint(jax.random.PRNGKey(2), (batch_size,),
-                                    0, classes),
-    })
+    def batches():
+        epoch = 0
+        while True:
+            yield from loader.epoch(epoch)
+            epoch += 1
 
+    it = prefetch_to_device(batches(), sharding, size=2)
     for _ in range(3):  # warmup / compile
-        state, metrics = step(state, batch)
-    float(metrics["loss"])  # value fetch = hard sync (block_until_ready
-    # alone does not force execution through remote-device tunnels)
+        state, metrics = step(state, next(it))
+    _sync(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, metrics = step(state, batch)
-    float(metrics["loss"])
+        state, metrics = step(state, next(it))
+    _sync(metrics["loss"])
     dt = time.perf_counter() - t0
+    it.close()
 
     imgs_per_sec = steps * batch_size / dt
     per_accel = imgs_per_sec / n_dev
-    baseline_per_accel = 1828.0 / 8.0  # reference README.md:70, 8x V100
+    return {"imgs_per_sec": round(imgs_per_sec, 1),
+            "vs_baseline": round(per_accel / (1828.0 / 8.0), 3)}
+
+
+def bench_transformer(on_tpu: bool) -> dict:
+    """Causal LM train step: tokens/s + MFU vs the chip's bf16 peak."""
+    from edl_tpu.models.transformer import (Transformer, TransformerConfig,
+                                            lm_loss_fn)
+    from edl_tpu.parallel import mesh as mesh_lib, sharding as shd
+    from edl_tpu.train.state import TrainState
+    from edl_tpu.train.step import make_train_step
+
+    n_dev = len(jax.devices())
+    if on_tpu:
+        cfg_kw = dict(vocab_size=32768, d_model=1024, n_heads=16,
+                      n_layers=8, d_ff=4096, max_len=1024,
+                      dtype=jnp.bfloat16)
+        B, S, steps = 16 * n_dev, 1024, 16
+    else:
+        cfg_kw = dict(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
+                      d_ff=128, max_len=128, dtype=jnp.float32)
+        B, S, steps = 2 * n_dev, 64, 2
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": n_dev}))
+    cfg = TransformerConfig(mesh=mesh, **cfg_kw)
+    model = Transformer(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0,
+                              cfg.vocab_size)
+    variables = shd.init_sharded(
+        lambda: model.init(jax.random.PRNGKey(0), toks, train=False), mesh)
+    state = TrainState.create(apply_fn=model.apply,
+                              params=variables["params"],
+                              tx=optax.adamw(1e-3))
+    step = make_train_step(lm_loss_fn, donate=False)
+    batch = {"tokens": mesh_lib.shard_batch(mesh, toks)}
+
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    _sync(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    _sync(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * B * S / dt
+
+    # Analytic model FLOPs/step (PaLM-style accounting): 6*T*P_matmul for
+    # the matmuls (fwd+bwd), + causal attention scores/values at
+    # 12*L*B*S^2*d * 0.5.
+    d, L, V, ff = cfg.d_model, cfg.n_layers, cfg.vocab_size, cfg.d_ff
+    p_matmul = L * (4 * d * d + 2 * d * ff) + d * V  # lm_head; embed=gather
+    flops_step = 6 * (B * S) * p_matmul + 0.5 * 12 * L * B * S * S * d
+    peak = PEAK_BF16.get(jax.devices()[0].device_kind) if on_tpu else None
+    mfu = (flops_step * steps / dt) / (peak * n_dev) if peak else None
+    return {"tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu": round(mfu, 4) if mfu is not None else None}
+
+
+def bench_distill(on_tpu: bool) -> dict:
+    """Co-located distill e2e: student train + in-chip teacher serving.
+
+    The full student-side stack runs for real — DistillReader's pipeline
+    threads, TCP tensor wire, request-coalescing teacher batcher — with
+    teacher inference sharing this chip (the reference's co-located mode,
+    README.md:71; its disaggregated 1514 img/s headline used 40 extra
+    teacher GPUs, README.md:72)."""
+    from edl_tpu.data.pipeline import ArraySource, DataLoader
+    from edl_tpu.distill.reader import DistillReader
+    from edl_tpu.distill.teacher_server import TeacherServer
+    from edl_tpu.models.resnet import ResNet50, ResNet50_vd, ResNetTiny
+    from edl_tpu.parallel import mesh as mesh_lib
+    from edl_tpu.train import classification as cls
+    from edl_tpu.train.step import make_train_step
+
+    n_dev = len(jax.devices())
+    if on_tpu:
+        student = ResNet50_vd(num_classes=1000, dtype=jnp.bfloat16)
+        teacher = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        per_dev_batch, hw, classes, steps = 128, 224, 1000, 10
+        source_n, teacher_bs = 256, 16
+    else:
+        student = ResNetTiny(num_classes=10, dtype=jnp.float32)
+        teacher = ResNetTiny(num_classes=10, dtype=jnp.float32)
+        per_dev_batch, hw, classes, steps = 8, 32, 10, 3
+        # source must hold >= a few GLOBAL batches (8 per-dev x n_dev)
+        source_n, teacher_bs = 64 * len(jax.devices()), 4
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": n_dev}))
+    batch_size = per_dev_batch * n_dev
+    sharding = mesh_lib.data_sharding(mesh)
+
+    # Teacher: jitted forward served over the TCP tensor wire, in-process
+    # (same chip) with request coalescing across the reader's workers.
+    tstate = cls.create_state(teacher, jax.random.PRNGKey(7),
+                              (1, hw, hw, 3), optax.identity())
+
+    @jax.jit
+    def tforward(images):
+        variables = {"params": tstate.params}
+        if tstate.batch_stats is not None:
+            variables["batch_stats"] = tstate.batch_stats
+        return tstate.apply_fn(variables, images, train=False)
+
+    def tpredict(feeds):
+        return {"logits": np.asarray(tforward(jnp.asarray(feeds["image"])),
+                                     np.float32)}
+
+    # Pre-compile every serving bucket OUTSIDE the serving path: a first
+    # compile (tens of seconds on TPU) inside a predict RPC would blow the
+    # client timeout and spiral into retries.
+    for b in (teacher_bs, 2 * teacher_bs, 4 * teacher_bs):
+        tpredict({"image": np.zeros((b, hw, hw, 3), np.float32)})
+
+    state = cls.create_state(student, jax.random.PRNGKey(0), (1, hw, hw, 3),
+                             optax.sgd(0.1, momentum=0.9, nesterov=True))
+
+    def distill_loss(state, params, batch):
+        # soft-label CE against teacher logits (reference recipe,
+        # example/distill/resnet/train_with_fleet.py:254-259)
+        variables = {"params": params}
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+        logits, mutated = state.apply_fn(
+            variables, batch["image"], train=True, mutable=["batch_stats"])
+        soft = jax.nn.softmax(batch["logits"].astype(jnp.float32))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        loss = -jnp.mean(jnp.sum(soft * logp, axis=-1))
+        return loss, {"batch_stats": mutated["batch_stats"]}
+
+    step = make_train_step(distill_loss, donate=True)
+
+    rng = np.random.default_rng(1)
+    source = ArraySource({
+        "image": rng.normal(size=(source_n, hw, hw, 3)).astype(np.float32),
+        "label": rng.integers(0, classes, size=(source_n,)).astype(np.int32),
+    })
+    loader = DataLoader(source, batch_size)
+
+    server = TeacherServer(tpredict, max_batch=4 * teacher_bs,
+                           buckets=(teacher_bs, 2 * teacher_bs,
+                                    4 * teacher_bs)).start()
+    try:
+        endpoint = f"127.0.0.1:{server.port}"
+
+        def batches():
+            epoch = 0
+            while True:
+                yield from loader.epoch(epoch)
+                epoch += 1
+
+        dreader = DistillReader(batches, feeds=("image",),
+                                predicts=("logits",), teachers=[endpoint],
+                                teacher_batch_size=teacher_bs,
+                                rpc_timeout=120.0)
+        it = dreader()
+        warm = 2
+        for _ in range(warm):
+            batch = next(it)
+            placed = {k: jax.device_put(v, sharding) for k, v in
+                      batch.items() if k in ("image", "logits")}
+            state, metrics = step(state, placed)
+        _sync(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            batch = next(it)
+            placed = {k: jax.device_put(v, sharding) for k, v in
+                      batch.items() if k in ("image", "logits")}
+            state, metrics = step(state, placed)
+        _sync(metrics["loss"])
+        dt = time.perf_counter() - t0
+        it.close()
+        dreader.close()
+    finally:
+        server.stop()
+
+    imgs_per_sec = steps * batch_size / dt
+    per_accel = imgs_per_sec / n_dev
+    return {"imgs_per_sec": round(imgs_per_sec, 1),
+            "vs_colocated_baseline": round(per_accel / (656.0 / 8.0), 3)}
+
+
+def main() -> None:
+    on_tpu = jax.devices()[0].platform == "tpu"
+    resnet = bench_resnet_dataloader(on_tpu)
+    transformer = bench_transformer(on_tpu)
+    distill = bench_distill(on_tpu)
     print(json.dumps({
         "metric": "resnet50_vd_train_imgs_per_sec",
-        "value": round(imgs_per_sec, 1),
+        "value": resnet["imgs_per_sec"],
         "unit": "img/s",
-        "vs_baseline": round(per_accel / baseline_per_accel, 3),
+        "vs_baseline": resnet["vs_baseline"],
+        "extras": {
+            "input_pipeline": "DataLoader+prefetch_to_device",
+            "transformer_tokens_per_sec": transformer["tokens_per_sec"],
+            "transformer_mfu": transformer["mfu"],
+            "distill_student_imgs_per_sec": distill["imgs_per_sec"],
+            "distill_vs_colocated_baseline":
+                distill["vs_colocated_baseline"],
+        },
     }))
 
 

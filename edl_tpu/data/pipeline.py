@@ -96,6 +96,13 @@ class DataLoader:
                              self.shuffle)
         mine = perm[self.rank::self.world]
         n_steps = self.steps_per_epoch()
+        if n_steps == 0:
+            # An empty epoch is always a config bug (batch bigger than the
+            # shard); yielding nothing turns it into a silent hang for
+            # any epoch-looping consumer.
+            raise EdlDataError(
+                f"shard of {len(mine)} samples yields 0 batches of "
+                f"{self.batch_size} (world={self.world})")
         rng = np.random.default_rng(
             (self.seed + 1) * 1_000_003 + epoch * 4093 + self.rank)
         for i in range(n_steps):

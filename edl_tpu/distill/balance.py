@@ -17,7 +17,10 @@ Invariants (property-tested in tests/test_balance.py):
   I3. when S > 0, every client holds exactly ``client_cap`` servers
       (capacity S*ceil(C/S) >= C always suffices);
   I4. server loads are balanced: max(load) - min(load) <= 1 whenever every
-      server is eligible for every client;
+      server is eligible for every client — including after joins into a
+      long-lived assignment (the skew-repair pass shifts links off the
+      most-loaded servers, so a new teacher is put to work immediately
+      instead of waiting for client churn);
   I5. versions bump iff the client's server set changed.
 
 Unlike the reference this is a standalone, lock-free-by-construction value
@@ -123,6 +126,29 @@ class ServiceBalance:
                 best = min(candidates, key=lambda s: (load[s], s))
                 links.append(best)
                 load[best] += 1
+
+        # Phase 3 — skew repair: without it I4 holds only for fresh
+        # assignments — a teacher joining a long-lived service would sit
+        # idle until client churn, because phase 1 keeps every legal old
+        # link. Shift one link at a time from the most- to the
+        # least-loaded server until the gap closes to <= 1.
+        if self.servers:
+            while True:
+                lo = min(self.servers, key=lambda s: (load[s], s))
+                hi = max(self.servers, key=lambda s: (load[s], s))
+                if load[hi] - load[lo] <= 1:
+                    break
+                moved = False
+                for cid in sorted(self.clients):
+                    links = kept[cid]
+                    if hi in links and lo not in links:
+                        links[links.index(hi)] = lo
+                        load[hi] -= 1
+                        load[lo] += 1
+                        moved = True
+                        break
+                if not moved:
+                    break
 
         changed = []
         for cid, links in kept.items():

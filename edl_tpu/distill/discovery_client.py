@@ -181,10 +181,16 @@ class DiscoveryClient:
 
     def stop(self) -> None:
         self._stop.set()
+        joined = True
         if self._thread is not None:
-            self._thread.join(timeout=2.0)
+            # The heartbeat RPC's socket timeout (5s) outlives this join:
+            # if the thread is still mid-RPC, writing a deregister frame on
+            # the same socket would interleave with it, so skip the
+            # courtesy deregister and let the lease TTL clean us up.
+            self._thread.join(timeout=6.0)
+            joined = not self._thread.is_alive()
         try:
-            if self._sock is not None:
+            if self._sock is not None and joined:
                 self._call(op="deregister", client=self.client_id,
                            service=self.service)
         except (OSError, wire.WireError, EdlError):

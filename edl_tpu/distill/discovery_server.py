@@ -153,9 +153,22 @@ class BalanceTable:
 
     def stats(self) -> dict:
         with self._lock:
+            names = list(self._services)
+        # Teacher-reported utilization (registry `info`, published by the
+        # registrar's stats loop) — the scheduler-facing performance view.
+        info: dict[str, dict] = {}
+        for name in names:
+            try:
+                info[name] = {m.server: m.info
+                              for m in self.registry.get_service(name)}
+            except Exception as exc:
+                log.warning("utilization read for %s failed: %s", name, exc)
+                info[name] = {}
+        with self._lock:
             return {name: {"servers": list(svc.servers),
                            "clients": len(svc.clients),
-                           "loads": svc.loads()}
+                           "loads": svc.loads(),
+                           "utilization": info.get(name, {})}
                     for name, svc in self._services.items()}
 
 

@@ -155,6 +155,7 @@ class _EpochPipeline:
         self.error: list[str] = []
         n0 = max(1, len(reader._get_servers()))
         self.sem = threading.Semaphore(2 * n0 + 2)
+        self._sem_slots = 2 * n0 + 2   # manage-thread-owned bookkeeping
         self.reader_done = threading.Event()
         self.total_tasks = 0        # valid once reader_done is set
         self.total_batches = 0
@@ -169,6 +170,18 @@ class _EpochPipeline:
             if self.sem.acquire(timeout=0.1):
                 return True
         return False
+
+    def resize_window(self, n_teachers: int) -> None:
+        """Track the live teacher count: in-flight window = 2*teachers+2
+        (the reference sizes it live, distill_reader.py:215), so a teacher
+        joining mid-epoch actually widens throughput. Called only from the
+        manage thread; shrink is best-effort (never blocks the pipeline)."""
+        target = 2 * max(1, n_teachers) + 2
+        while self._sem_slots < target:
+            self.sem.release()
+            self._sem_slots += 1
+        while self._sem_slots > target and self.sem.acquire(blocking=False):
+            self._sem_slots -= 1
 
 
 class DistillReader:
@@ -293,6 +306,7 @@ class DistillReader:
                     w = _PredictWorker(p, ep)
                     workers[ep] = w
                     w.start()
+            p.resize_window(len(workers))
             if p.stop.wait(self.manage_interval):
                 return
 
@@ -357,5 +371,9 @@ class DistillReader:
                     next_yield += 1
         finally:
             p.stop.set()
-            for w in workers.values():
+            # The manage thread may be mid-install/remove; join it first so
+            # the worker dict is stable (and no worker is added after we
+            # snapshot), then signal every worker.
+            threads[1].join(timeout=2.0)
+            for w in list(workers.values()):
                 w.stop_event.set()

@@ -6,6 +6,13 @@ wait until the teacher server answers TCP, then register it under the
 service name with a TTL lease; the Registration keeps the lease alive and
 re-registers after expiry (bounded retries). Deregistration on stop.
 
+With ``stats_interval > 0`` the registrar also polls the teacher's
+``stats`` op and publishes rows/s + utilization into the registry ``info``
+field — the "report job performance to the scheduler" data path the
+reference reserves the field for (discovery/register.py:36-40,
+doc/edl_collective_design_doc.md:28-31). Consumers read it from the
+registry (ServerMeta.info) or the discovery server's ``stats`` op.
+
 CLI (run next to each teacher server):
     python -m edl_tpu.distill.registrar --store 127.0.0.1:2379 \
         --service resnet_teacher --server 10.0.0.7:23900
@@ -14,6 +21,7 @@ CLI (run next to each teacher server):
 from __future__ import annotations
 
 import argparse
+import json
 import threading
 import time
 
@@ -34,7 +42,8 @@ class TeacherRegistrar:
 
     def __init__(self, store: Store, service: str, server: str, *,
                  info: str = "", ttl: float = 10.0, root: str = DISTILL_ROOT,
-                 probe_timeout: float = 60.0, probe_interval: float = 0.5):
+                 probe_timeout: float = 60.0, probe_interval: float = 0.5,
+                 stats_interval: float = 0.0):
         self.registry = ServiceRegistry(store, root=root)
         self.service = service
         self.server = server
@@ -42,7 +51,11 @@ class TeacherRegistrar:
         self.ttl = ttl
         self.probe_timeout = probe_timeout
         self.probe_interval = probe_interval
+        self.stats_interval = stats_interval
         self._registration: Registration | None = None
+        self._stats_stop = threading.Event()
+        self._stats_thread: threading.Thread | None = None
+        self._last_stats: dict | None = None
 
     def wait_alive(self) -> None:
         deadline = time.monotonic() + self.probe_timeout
@@ -58,9 +71,58 @@ class TeacherRegistrar:
         self._registration = self.registry.register(
             self.service, self.server, info=self.info, ttl=self.ttl)
         log.info("registered teacher %s under %s", self.server, self.service)
+        if self.stats_interval > 0:
+            self._stats_thread = threading.Thread(
+                target=self._stats_loop, daemon=True,
+                name=f"teacher-stats-{self.server}")
+            self._stats_thread.start()
         return self
 
+    # -- utilization publishing ---------------------------------------------
+
+    def _poll_stats(self) -> dict | None:
+        from edl_tpu.distill.teacher_server import TeacherClient
+        try:
+            client = TeacherClient(self.server, timeout=5.0)
+        except OSError:
+            return None
+        try:
+            return client.stats()
+        except Exception:
+            return None
+        finally:
+            client.close()
+
+    def _utilization_info(self, cur: dict, prev: dict | None,
+                          dt: float) -> str:
+        d_rows = cur["served_rows"] - (prev or {}).get("served_rows", 0)
+        d_busy = cur["busy_s"] - (prev or {}).get("busy_s", 0.0)
+        return json.dumps({
+            "rows_per_sec": round(d_rows / max(dt, 1e-9), 1),
+            "util": round(min(1.0, d_busy / max(dt, 1e-9)), 3),
+            "queue_depth": cur.get("queue_depth", 0),
+        }, sort_keys=True)
+
+    def _stats_loop(self) -> None:
+        last_t = time.monotonic()
+        while not self._stats_stop.wait(self.stats_interval):
+            cur = self._poll_stats()
+            now = time.monotonic()
+            if cur is None or self._registration is None:
+                continue
+            try:
+                info = self._utilization_info(cur, self._last_stats,
+                                              now - last_t)
+                self._registration.update_info(info)
+            except Exception as exc:
+                log.warning("utilization publish failed: %s", exc)
+            self._last_stats, last_t = cur, now
+
     def stop(self, deregister: bool = True) -> None:
+        self._stats_stop.set()
+        if self._stats_thread is not None:
+            self._stats_thread.join(timeout=2.0)
+            self._stats_thread = None
         if self._registration is not None:
             self._registration.stop()
             self._registration = None
@@ -83,10 +145,14 @@ def main(argv=None) -> int:
     parser.add_argument("--ttl", type=float, default=10.0)
     parser.add_argument("--root", default=DISTILL_ROOT)
     parser.add_argument("--probe-timeout", type=float, default=60.0)
+    parser.add_argument("--stats-interval", type=float, default=5.0,
+                        help="seconds between utilization publishes "
+                             "(0 disables)")
     args = parser.parse_args(argv)
     registrar = TeacherRegistrar(
         StoreClient(args.store), args.service, args.server, info=args.info,
-        ttl=args.ttl, root=args.root, probe_timeout=args.probe_timeout)
+        ttl=args.ttl, root=args.root, probe_timeout=args.probe_timeout,
+        stats_interval=args.stats_interval)
     registrar.start()
     try:
         threading.Event().wait()

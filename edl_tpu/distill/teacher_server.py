@@ -68,6 +68,14 @@ class Batcher:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="teacher-batcher")
+        # Cumulative utilization counters (the registry `info` data source:
+        # reference discovery/register.py:36-40 reserves the field for
+        # "report job performance to the scheduler").
+        self._stats_lock = threading.Lock()
+        self._served_rows = 0
+        self._served_requests = 0
+        self._busy_s = 0.0
+        self._started_at = time.monotonic()
 
     def start(self) -> "Batcher":
         self._thread.start()
@@ -118,6 +126,10 @@ class Batcher:
             except Exception as exc:
                 log.exception("batch predict failed")
                 for req in group:
+                    if req.done.is_set():
+                        # Heterogeneous requests already served (recursively)
+                        # by _serve_group must not be retroactively failed.
+                        continue
                     req.error = f"{type(exc).__name__}: {exc}"
                     req.done.set()
 
@@ -137,14 +149,28 @@ class Batcher:
                 pad = np.zeros((bucket - rows,) + cat.shape[1:], cat.dtype)
                 cat = np.concatenate([cat, pad], axis=0)
             feeds[name] = cat
+        t0 = time.monotonic()
         outs = self.predict_fn(feeds)
         outs = {k: np.asarray(v) for k, v in outs.items()}
+        with self._stats_lock:
+            self._busy_s += time.monotonic() - t0
+            self._served_rows += rows
+            self._served_requests += len(group)
         offset = 0
         for req in group:
             req.result = {k: v[offset:offset + req.rows]
                           for k, v in outs.items()}
             offset += req.rows
             req.done.set()
+
+    def stats(self) -> dict:
+        """Cumulative serving counters (consumed by TeacherRegistrar)."""
+        with self._stats_lock:
+            return {"served_rows": self._served_rows,
+                    "served_requests": self._served_requests,
+                    "busy_s": round(self._busy_s, 4),
+                    "uptime_s": round(time.monotonic() - self._started_at, 4),
+                    "queue_depth": self._q.qsize()}
 
     def stop(self) -> None:
         self._stop.set()
@@ -179,6 +205,8 @@ class _Handler(socketserver.BaseRequestHandler):
         op = meta.get("op")
         if op == "ping":
             return {"ok": True}, {}
+        if op == "stats":
+            return {"ok": True, **batcher.stats()}, {}
         if op == "predict":
             if not tensors:
                 return {"ok": False, "error": "no feed tensors"}, {}
@@ -264,6 +292,15 @@ class TeacherClient:
             return bool(meta.get("ok"))
         except (tensor_wire.TensorWireError, OSError):
             return False
+
+    def stats(self) -> dict:
+        """Serving counters of the remote teacher (op: stats)."""
+        tensor_wire.send_tensors(self._sock, {"op": "stats"})
+        meta, _ = tensor_wire.recv_tensors(self._sock)
+        if not meta.get("ok"):
+            raise tensor_wire.TensorWireError(
+                meta.get("error", "stats failed"))
+        return {k: v for k, v in meta.items() if k != "ok"}
 
     def close(self) -> None:
         try:

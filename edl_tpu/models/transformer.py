@@ -130,10 +130,14 @@ class Transformer(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = True):
         cfg = self.cfg
+        # Table axes use the dedicated (vocab_table, embed_table) logical
+        # names: vocab stays unsharded so the token gather partitions
+        # trivially (no involuntary table rematerialization), embed splits
+        # over tp. See sharding.DEFAULT_RULES.
         embed = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
-                nn.initializers.normal(0.02), ("vocab", "embed")),
+                nn.initializers.normal(0.02), ("vocab_table", "embed_table")),
             name="tok_embed")
         pos_embed = self.param(
             "pos_embed",

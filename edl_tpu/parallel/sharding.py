@@ -33,6 +33,14 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
     ("heads", "tp"),
     ("kv", None),
     ("mlp", "tp"),
+    # Embedding-table axes. The token-id gather cannot be partitioned
+    # along its vocab (operand) dim — XLA falls back to "involuntary full
+    # rematerialization", all-gathering the whole table every step — so
+    # the table shards along the embedding dim only (tp); the gather then
+    # partitions trivially and the cheap reshard is on the (b, s, d)
+    # activations, not the (V, d) table.
+    ("vocab_table", None),
+    ("embed_table", "tp"),
 )
 
 

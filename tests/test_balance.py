@@ -20,13 +20,12 @@ def check_invariants(svc: ServiceBalance, fresh: bool = False):
         for cid, links in svc.clients.items():
             assert len(links.servers) == client_cap, \
                 f"I3: {cid} has {len(links.servers)} != {client_cap}"
-        if fresh:
-            # I4 holds only for from-scratch assignment: incremental
-            # rebalances deliberately keep legal existing links (minimal
-            # churn, like the reference's break-excess-only policy), which
-            # can leave a newly joined server under-loaded.
-            assert max(loads.values()) - min(loads.values()) <= 1, \
-                f"I4: unbalanced {loads}"
+        # I4 holds for fresh AND incremental rebalances: phase 1 keeps
+        # legal existing links (minimal churn), and the skew-repair pass
+        # shifts links from the most- to the least-loaded server until the
+        # gap closes, so a joining teacher is loaded immediately.
+        assert max(loads.values()) - min(loads.values()) <= 1, \
+            f"I4: unbalanced {loads}"
 
 
 def test_caps_formulas():
@@ -159,3 +158,22 @@ def test_expire_clients():
     assert set(svc.clients) == {"c1"}
     svc.rebalance()
     check_invariants(svc)
+
+
+def test_late_joining_server_loaded_immediately():
+    # The I4 skew-repair case: a saturated long-lived service gets a new
+    # teacher; the next rebalance must shift load onto it instead of
+    # waiting for client churn.
+    svc = ServiceBalance("s")
+    svc.set_servers(["t0", "t1"])
+    for i in range(8):
+        svc.add_client(f"c{i}")
+    svc.rebalance()
+    assert sorted(svc.loads().values()) == [4, 4]
+    svc.set_servers(["t0", "t1", "t2"])
+    changed = svc.rebalance()
+    check_invariants(svc)
+    loads = svc.loads()
+    assert loads["t2"] >= 2, f"new teacher idle: {loads}"
+    assert max(loads.values()) - min(loads.values()) <= 1
+    assert changed, "no client was re-versioned despite moved links"

@@ -81,3 +81,85 @@ def test_discovery_driven_distill_with_churn():
         if t2 is not None:
             t2[0].stop()
             t2[1].stop()
+
+
+def test_utilization_published_and_surfaced():
+    """The scheduler data path (reference discovery/register.py:36-40 info
+    field): teacher serving counters -> registrar stats loop -> registry
+    info -> discovery server stats op."""
+    import json
+
+    from edl_tpu.distill.teacher_server import TeacherClient
+
+    store = InMemStore()
+
+    def predict(feeds):
+        rows = next(iter(feeds.values())).shape[0]
+        return {"logits": np.zeros((rows, 4), np.float32)}
+
+    srv = TeacherServer(predict, host="127.0.0.1").start()
+    endpoint = f"127.0.0.1:{srv.port}"
+    reg = TeacherRegistrar(store, "svc", endpoint, ttl=5.0,
+                           stats_interval=0.1).start()
+    try:
+        client = TeacherClient(endpoint)
+        for _ in range(3):
+            client.predict({"image": np.zeros((4, 8), np.float32)})
+        raw = client.stats()
+        assert raw["served_rows"] >= 12 and raw["served_requests"] >= 3
+        client.close()
+
+        deadline = time.time() + 5
+        info = ""
+        while time.time() < deadline:
+            metas = reg.registry.get_service("svc")
+            if metas and metas[0].info:
+                info = metas[0].info
+                break
+            time.sleep(0.05)
+        doc = json.loads(info)
+        assert {"rows_per_sec", "util", "queue_depth"} <= set(doc)
+        assert doc["rows_per_sec"] >= 0.0
+
+        # Surfaced through the discovery server's stats op.
+        disco = DiscoveryServer(store, host="127.0.0.1",
+                                tick_interval=0.1).start()
+        try:
+            disco.table.register("client-1", "svc")
+            stats = disco.table.stats()
+            assert endpoint in stats["svc"]["utilization"]
+            assert stats["svc"]["utilization"][endpoint] == info or \
+                json.loads(stats["svc"]["utilization"][endpoint]).keys() \
+                == doc.keys()
+        finally:
+            disco.stop()
+    finally:
+        reg.stop()
+        srv.stop()
+
+
+def test_inflight_window_grows_when_teacher_joins():
+    """D5's spirit (reference distill_reader.py:215 sizes the semaphore
+    live): a teacher joining mid-epoch widens the in-flight window."""
+    from edl_tpu.distill.reader import _EpochPipeline
+
+    class _FakeReader:
+        predicts = ("p",)
+        max_retries = 3
+        _client_factory = staticmethod(lambda ep: None)
+
+        @staticmethod
+        def _get_servers():
+            return ["t0"]
+
+    p = _EpochPipeline(_FakeReader())
+    assert p._sem_slots == 4            # 2*1+2
+    p.resize_window(3)
+    assert p._sem_slots == 8            # 2*3+2
+    # 8 acquires must now succeed without blocking.
+    got = sum(p.sem.acquire(blocking=False) for _ in range(9))
+    assert got == 8
+    for _ in range(got):
+        p.sem.release()
+    p.resize_window(1)                  # best-effort shrink
+    assert p._sem_slots == 4
