@@ -78,10 +78,10 @@ int64_t Store::put_unlocked(const std::string& key, const std::string& value,
   if (lease != 0) leases_[lease].keys.insert(key);
   emit(Event{"PUT", key, value, rev});
   if (log)
-    wal_append(Json(JsonObject{{"o", Json("put")},
-                               {"k", Json(key)},
-                               {"v", Json(value)},
-                               {"l", Json(lease)}}));
+    wal_append(JsonObject{{"o", Json("put")},
+               {"k", Json(key)},
+               {"v", Json(value)},
+               {"l", Json(lease)}});
   return rev;
 }
 
@@ -93,7 +93,7 @@ bool Store::del_unlocked(const std::string& key, bool log) {
   detach(key, rec);
   emit(Event{"DELETE", key, rec.value, bump()});
   if (log)
-    wal_append(Json(JsonObject{{"o", Json("del")}, {"k", Json(key)}}));
+    wal_append(JsonObject{{"o", Json("del")}, {"k", Json(key)}});
   return true;
 }
 
@@ -108,8 +108,8 @@ int64_t Store::lease_grant_unlocked(double ttl, int64_t forced_id, bool log) {
                          std::chrono::duration<double>(ttl));
   leases_[id] = std::move(lease);
   if (log)
-    wal_append(Json(JsonObject{
-        {"o", Json("lg")}, {"id", Json(id)}, {"ttl", Json(ttl)}}));
+    wal_append(JsonObject{
+        {"o", Json("lg")}, {"id", Json(id)}, {"ttl", Json(ttl)}});
   return id;
 }
 
@@ -128,7 +128,7 @@ bool Store::lease_revoke_unlocked(int64_t lease, bool log) {
     }
   }
   if (log)
-    wal_append(Json(JsonObject{{"o", Json("lr")}, {"id", Json(lease)}}));
+    wal_append(JsonObject{{"o", Json("lr")}, {"id", Json(lease)}});
   return true;
 }
 
@@ -262,9 +262,10 @@ void Store::sweep() {
 
 // ---- persistence ----------------------------------------------------------
 
-void Store::wal_append(const Json& op) {
+void Store::wal_append(JsonObject op) {
   if (!wal_ || replaying_) return;
-  std::string line = op.dump();
+  op.emplace("s", Json(++seq_));
+  std::string line = Json(std::move(op)).dump();
   line += '\n';
   if (std::fwrite(line.data(), 1, line.size(), wal_) != line.size())
     throw std::runtime_error("WAL write failed");
@@ -291,6 +292,7 @@ void Store::write_snapshot() {
     leases.push_back(
         Json(JsonArray{Json(kv.second.id), Json(kv.second.ttl)}));
   Json snap(JsonObject{{"revision", Json(revision_)},
+                       {"seq", Json(seq_)},
                        {"next_lease", Json(next_lease_)},
                        {"records", Json(std::move(recs))},
                        {"leases", Json(std::move(leases))}});
@@ -321,6 +323,7 @@ void Store::load() {
     if (!text.empty()) {
       Json snap = Json::parse(text);
       revision_ = snap["revision"].as_int();
+      seq_ = snap["seq"].as_int(0);
       next_lease_ = snap["next_lease"].as_int(1);
       for (const auto& lease : snap["leases"].as_array()) {
         const auto& arr = lease.as_array();
@@ -348,7 +351,13 @@ void Store::load() {
     while (std::getline(wal_in, line)) {
       if (line.empty()) continue;
       try {
-        replay_line(line);
+        Json op = Json::parse(line);
+        // A crash between snapshot rename and WAL truncation leaves the
+        // whole old WAL behind a snapshot that already contains it; the
+        // seq stamp tells us which ops those are.
+        if (op.has("s") && op["s"].as_int() <= seq_) continue;
+        replay_op(op);
+        if (op.has("s")) seq_ = op["s"].as_int();
       } catch (const std::exception&) {
         // Torn tail write (crash mid-append): stop replaying here.
         break;
@@ -362,8 +371,7 @@ void Store::load() {
   replaying_ = false;
 }
 
-void Store::replay_line(const std::string& line) {
-  Json op = Json::parse(line);
+void Store::replay_op(const Json& op) {
   const std::string& kind = op["o"].as_string();
   if (kind == "put") {
     try {

@@ -97,9 +97,9 @@ class Store {
   bool lease_revoke_unlocked(int64_t lease, bool log);
 
   // persistence -------------------------------------------------------
-  void wal_append(const Json& op);
+  void wal_append(JsonObject op);
   void load();
-  void replay_line(const std::string& line);
+  void replay_op(const Json& op);
   void maybe_snapshot();  // caller holds mutex
   void write_snapshot();
 
@@ -116,6 +116,11 @@ class Store {
   bool fsync_ = true;
   size_t snapshot_every_;
   size_t wal_lines_ = 0;
+  // Monotonic op sequence stamped onto every WAL line and recorded in the
+  // snapshot, so replay can skip ops the snapshot already contains (the
+  // crash window between snapshot rename and WAL truncation would
+  // otherwise re-apply the whole old WAL and re-bump revisions).
+  int64_t seq_ = 0;
   std::FILE* wal_ = nullptr;
   bool replaying_ = false;
 };

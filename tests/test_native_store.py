@@ -214,6 +214,122 @@ def test_snapshot_compaction_and_restart(binary, tmp_path):
         client2.close()
 
 
+def test_crash_between_snapshot_rename_and_wal_truncate(binary, tmp_path):
+    """The snapshot-then-truncate window: a crash after the snapshot rename
+    but before the WAL truncation leaves the whole pre-snapshot WAL on
+    disk. Replay must skip ops the snapshot already contains (seq stamps)
+    or revisions re-bump and diverge from pre-crash values."""
+    data_dir = tmp_path / "window-data"
+    proc, client, port = start_daemon(
+        binary, tmp_path, data_dir=data_dir,
+        extra=("--snapshot-every", "5", "--no-fsync"))
+    try:
+        for i in range(4):                      # WAL: seq 1..4, no snapshot
+            client.put(f"/w/{i}", str(i))
+        pre_snapshot_wal = (data_dir / "wal.log").read_bytes()
+        client.put("/w/4", "4")                 # 5th append -> snapshot, WAL truncated
+        assert (data_dir / "snapshot.json").exists()
+        client.put("/w/5", "5")                 # post-snapshot WAL: seq 6
+        revs = {k: client.get(k).revision for k in
+                ("/w/0", "/w/3", "/w/5")}
+        last_rev = client.get("/w/5").revision
+    finally:
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=5)
+        client.close()
+
+    # Reconstruct the crash window: the old WAL lines sit in front of the
+    # post-snapshot ones, exactly as if truncation never happened.
+    post_wal = (data_dir / "wal.log").read_bytes()
+    (data_dir / "wal.log").write_bytes(pre_snapshot_wal + post_wal)
+
+    proc2, client2, _ = start_daemon(binary, tmp_path, data_dir=data_dir,
+                                     port=port)
+    try:
+        for key, rev in revs.items():
+            rec = client2.get(key)
+            assert rec.value == key[-1]
+            assert rec.revision == rev, \
+                f"{key} revision re-bumped by duplicate WAL replay"
+        # The global counter also survives un-bumped.
+        assert client2.put("/w/new", "n") == last_rev + 1
+    finally:
+        proc2.terminate()
+        proc2.wait(timeout=5)
+        client2.close()
+
+
+@pytest.fixture(scope="session")
+def tsan_binary():
+    build = subprocess.run(["make", "-C", NATIVE_DIR, "tsan"],
+                           capture_output=True, text=True)
+    if build.returncode != 0:
+        pytest.skip(f"tsan build unavailable:\n{build.stderr[-500:]}")
+    return os.path.join(NATIVE_DIR, "edl-store-tsan")
+
+
+def test_concurrent_clients_under_tsan(tsan_binary, tmp_path):
+    """SURVEY §5 sanitizers: hammer the mutex-per-op store + sweeper thread
+    + thread-per-connection server from concurrent clients under
+    ThreadSanitizer; any data race aborts the daemon (halt_on_error)."""
+    import threading
+
+    port = net.free_port()
+    log_path = tmp_path / "tsan.log"
+    env = dict(os.environ,
+               TSAN_OPTIONS="halt_on_error=1 exitcode=66 abort_on_error=0")
+    proc = subprocess.Popen(
+        [tsan_binary, "--host", "127.0.0.1", "--port", str(port),
+         "--sweep-interval", "0.01", "--data-dir", str(tmp_path / "td"),
+         "--no-fsync", "--snapshot-every", "40"],
+        stdout=open(log_path, "ab"), stderr=subprocess.STDOUT, env=env)
+    client = StoreClient(f"127.0.0.1:{port}", timeout=10.0)
+    deadline = time.time() + 20
+    while time.time() < deadline and not client.ping():
+        time.sleep(0.1)
+    assert client.ping(), "tsan daemon never came up"
+    client.close()
+
+    errors = []
+
+    def worker(wid: int):
+        try:
+            c = StoreClient(f"127.0.0.1:{port}", timeout=10.0)
+            for i in range(60):
+                c.put(f"/stress/{wid}/{i % 7}", str(i))
+                c.get(f"/stress/{(wid + 1) % 6}/{i % 7}")
+                if i % 5 == 0:
+                    lease = c.lease_grant(0.05)   # sweeper races on purpose
+                    try:
+                        c.put(f"/stress/lease/{wid}", "x", lease=lease)
+                    except EdlLeaseExpired:
+                        pass   # sweeper won the race — the point is the race
+                    c.lease_keepalive(lease)
+                if i % 9 == 0:
+                    c.compare_and_swap(f"/stress/cas/{wid}", None, "v")
+                    c.events_since(0)
+                    c.delete_prefix(f"/stress/{wid}/")
+            c.close()
+        except Exception as exc:   # noqa: BLE001 — collected for assert
+            errors.append((wid, exc))
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        assert not errors, f"client errors (daemon died mid-run?): {errors}"
+        assert proc.poll() is None, \
+            f"daemon exited {proc.returncode} — TSAN report:\n" \
+            f"{log_path.read_bytes().decode(errors='replace')[-3000:]}"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+    report = log_path.read_bytes().decode(errors="replace")
+    assert "WARNING: ThreadSanitizer" not in report, report[-3000:]
+
+
 def test_garbage_bytes_close_connection_not_daemon(daemon):
     import socket
     host, port = daemon._endpoint.split(":")
