@@ -320,7 +320,7 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
     from edl_tpu.train.classification import create_state
     import optax
 
-    factory = getattr(zoo, model_name)
+    factory = zoo.get_model(model_name)
     model = factory(num_classes=num_classes)
     # Dense layers bind their kernel to the flattened input size, so init
     # must see the shape that will be served.
@@ -328,7 +328,17 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
                          optax.identity())
     if params_path:
         from edl_tpu.train.checkpoint import CheckpointManager
-        restored = CheckpointManager(params_path).restore(state)
+        from edl_tpu.utils.fs import split_scheme
+        # gs://... / hdfs://... params mirrors download before restore
+        # (reference download_hdfs_file, distill/utils.py:18)
+        scheme, rest = split_scheme(params_path)
+        if scheme not in ("", "file"):
+            import tempfile
+            local = tempfile.mkdtemp(prefix="edl-teacher-params-")
+            mgr = CheckpointManager(local, remote=params_path)
+        else:
+            mgr = CheckpointManager(rest if scheme == "file" else params_path)
+        restored = mgr.restore(state)
         if restored is not None:
             state = restored[0]
 
@@ -354,7 +364,8 @@ def main(argv=None) -> int:
                         help="edl_tpu.models factory name (mlp, resnet50_vd, ...)")
     parser.add_argument("--num-classes", type=int, default=10)
     parser.add_argument("--params", default="",
-                        help="checkpoint dir to restore params from")
+                        help="checkpoint dir (or gs:///hdfs:// mirror URI) "
+                             "to restore params from")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=23900)
     parser.add_argument("--input-key", default="image")

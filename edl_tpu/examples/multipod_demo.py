@@ -107,7 +107,12 @@ def main(argv=None) -> int:
             local = {k: v[lo:lo + local_bs] for k, v in g.items()}
             yield mesh_lib.form_global_batch(mesh, local)
 
-    loop = TrainLoop(step, state, config=LoopConfig(
+    from edl_tpu.utils.config import from_env
+    # from_env picks up the launcher-forwarded EDL_TPU_* overrides —
+    # notably EDL_TPU_CKPT_REMOTE for the gs:// checkpoint mirror on
+    # clusters without a shared FS (deploy/k8s/train-job.yaml).
+    loop = TrainLoop(step, state, config=from_env(
+        LoopConfig,
         num_epochs=args.epochs,
         ckpt_dir=env.checkpoint_path or None,
         log_every_steps=args.steps_per_epoch),

@@ -14,14 +14,31 @@ _LAZY = {
 }
 
 
+def get_model(name):
+    """Resolve a zoo factory by name, immune to submodule shadowing.
+
+    `getattr(models, "mlp")` can return the *submodule* once
+    `edl_tpu.models.mlp` has been imported anywhere (the import machinery
+    binds the submodule attribute on the package, which wins over
+    __getattr__) — so name-based consumers (teacher_server --model) must
+    resolve through this helper.
+    """
+    import importlib
+    for module, names in _LAZY.items():
+        if name in names:
+            mod = importlib.import_module(f"edl_tpu.models.{module}")
+            return getattr(mod, name)
+    if name in __all__:
+        return globals()[name]
+    raise AttributeError(f"unknown model {name!r}")
+
+
 def __getattr__(name):
     # Heavier model families load lazily to keep import cost low.
     for module, names in _LAZY.items():
         if name in names:
-            import importlib
             try:
-                mod = importlib.import_module(f"edl_tpu.models.{module}")
+                return get_model(name)
             except ModuleNotFoundError as exc:
                 raise AttributeError(name) from exc
-            return getattr(mod, name)
     raise AttributeError(name)

@@ -48,11 +48,19 @@ _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
 
 class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 process_index: int | None = None, sharded: bool = False):
+                 process_index: int | None = None, sharded: bool = False,
+                 remote: str | None = None):
+        """`remote`: optional URI root (file://, gs://, hdfs:// — see
+        utils/fs.py) mirroring the local dir. Rank 0 uploads each sealed
+        version after save; restore on a pod whose local dir lacks the
+        wanted version fetches it from the mirror first — the rank-0-
+        writes / everyone-reads story on clusters without a shared FS
+        (reference doc/fault_tolerance.md:30-45)."""
         self.directory = directory
         self.max_to_keep = max_to_keep
         self._process_index = process_index
         self.sharded = sharded
+        self.remote = remote
 
     @property
     def process_index(self) -> int:
@@ -110,8 +118,23 @@ class CheckpointManager:
             raise
         log.info("saved checkpoint %s (epoch=%d step=%d)",
                  self._path(version), status.epoch, status.step)
+        self._mirror(version)
         self._gc()
         return version
+
+    def _mirror(self, version: int) -> None:
+        if self.remote is None:
+            return
+        from edl_tpu.utils import fs
+        try:
+            fs.mirror_checkpoint(self.directory, version, self.remote,
+                                 keep=self.max_to_keep)
+        except fs.EdlFsError as exc:
+            # The local version is already sealed — a transient mirror
+            # failure (GCS 5xx etc.) must not kill the trainer; the next
+            # save's upload + LATEST flip supersedes this one.
+            log.warning("mirror of ckpt-%d to %s failed: %s", version,
+                        self.remote, exc)
 
     def _sync(self, tag: str) -> None:
         if jax.process_count() > 1:
@@ -175,6 +198,7 @@ class CheckpointManager:
             return None
         log.info("saved sharded checkpoint %s (epoch=%d step=%d)",
                  self._path(version), status.epoch, status.step)
+        self._mirror(version)
         self._gc()
         return version
 
@@ -201,8 +225,30 @@ class CheckpointManager:
         """
         if version is None:
             version = self.latest_version()
+            if self.remote is not None:
+                # The mirror may be ahead of this pod's local dir (e.g. a
+                # container restarted in place while rank 0 kept saving);
+                # restoring the stale local latest would diverge from the
+                # rest of the world, so prefer the remote LATEST marker
+                # whenever it is newer.
+                from edl_tpu.utils import fs
+                try:
+                    remote_latest = fs.remote_latest_version(self.remote)
+                except fs.EdlFsError as exc:
+                    log.warning("mirror %s unreachable for restore: %s",
+                                self.remote, exc)
+                    remote_latest = None
+                if remote_latest is not None and (version is None
+                                                  or remote_latest > version):
+                    version = fs.fetch_latest_checkpoint(self.remote,
+                                                         self.directory)
         if version is None:
             return None
+        if (not os.path.isdir(self._path(version))
+                and self.remote is not None):
+            from edl_tpu.utils import fs
+            fs.fetch_latest_checkpoint(self.remote, self.directory,
+                                       version=version)
         path = self._path(version)
         if sc.is_sharded_dir(path):
             state = sc.restore_sharded(path, target)

@@ -38,6 +38,9 @@ class LoopConfig:
     # Sharded (per-process chunk) checkpoints — required once params are
     # fsdp/tp-sharded; replicated msgpack is the small-model default.
     ckpt_sharded: bool = field(False, env="EDL_TPU_CHECKPOINT_SHARDED")
+    # Remote mirror URI (gs://, hdfs://, file://) — rank 0 uploads each
+    # sealed version, cold pods fetch before restore (utils/fs.py).
+    ckpt_remote: str | None = field(None, env="EDL_TPU_CKPT_REMOTE")
 
 
 class TrainLoop:
@@ -75,7 +78,8 @@ class TrainLoop:
             else jax.device_count())
         self.ckpt = (CheckpointManager(self.config.ckpt_dir,
                                        self.config.ckpt_max_to_keep,
-                                       sharded=self.config.ckpt_sharded)
+                                       sharded=self.config.ckpt_sharded,
+                                       remote=self.config.ckpt_remote)
                      if self.config.ckpt_dir else None)
         self.last_metrics: dict = {}
         # World size recorded in the restored checkpoint, set by

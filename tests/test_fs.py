@@ -1,0 +1,246 @@
+"""Remote-FS abstraction + checkpoint mirroring (utils/fs.py).
+
+Covers the reference's remote-store contract (doc/fault_tolerance.md:
+30-45 rank-0 uploads / everyone downloads; distill/utils.py:18 fetch of
+teacher files) without any cloud: LocalFS directly, and CommandFS through
+a cp/ls-backed command table — the same injection a gs:// deployment uses
+with gsutil.
+"""
+
+import json
+import os
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from edl_tpu.train.checkpoint import CheckpointManager
+from edl_tpu.train.state import TrainStatus
+from edl_tpu.utils import fs as fslib
+
+
+def _cp_command_fs():
+    """CommandFS over plain POSIX tools — the gsutil stand-in."""
+    return fslib.CommandFS(
+        exists_cmd=["test", "-e", "{uri}"],
+        list_cmd=["ls", "{uri}"],
+        upload_cmd=["cp", "-r", "{src}", "{dst}"],
+        download_cmd=["cp", "-r", "{src}", "{dst}"],
+        delete_cmd=["rm", "-rf", "{uri}"])
+
+
+@pytest.fixture(params=["local", "command"])
+def anyfs(request):
+    return fslib.LocalFS() if request.param == "local" else _cp_command_fs()
+
+
+class TestFileSystems:
+    def test_roundtrip_file(self, anyfs, tmp_path):
+        src = tmp_path / "a.txt"
+        src.write_text("hello")
+        remote = tmp_path / "remote" / "a.txt"
+        os.makedirs(remote.parent)
+        anyfs.upload(str(src), str(remote))
+        assert anyfs.exists(str(remote))
+        dst = tmp_path / "back.txt"
+        anyfs.download(str(remote), str(dst))
+        assert dst.read_text() == "hello"
+
+    def test_roundtrip_dir(self, anyfs, tmp_path):
+        src = tmp_path / "d"
+        (src / "sub").mkdir(parents=True)
+        (src / "x").write_text("1")
+        (src / "sub" / "y").write_text("2")
+        remote = tmp_path / "r" / "d"
+        os.makedirs(remote.parent)
+        anyfs.upload(str(src), str(remote))
+        dst = tmp_path / "d2"
+        anyfs.download(str(remote), str(dst))
+        assert (dst / "x").read_text() == "1"
+        assert (dst / "sub" / "y").read_text() == "2"
+
+    def test_listdir_and_delete(self, anyfs, tmp_path):
+        d = tmp_path / "dir"
+        d.mkdir()
+        (d / "b").write_text("")
+        (d / "a").write_text("")
+        assert anyfs.listdir(str(d)) == ["a", "b"]
+        assert anyfs.listdir(str(tmp_path / "absent")) == []
+        anyfs.delete(str(d / "a"))
+        assert anyfs.listdir(str(d)) == ["b"]
+        anyfs.delete(str(d / "a"))  # idempotent
+
+    def test_text_helpers(self, anyfs, tmp_path):
+        uri = str(tmp_path / "marker")
+        anyfs.write_text(uri, "7")
+        assert anyfs.read_text(uri) == "7"
+
+    def test_exists_false(self, anyfs, tmp_path):
+        assert not anyfs.exists(str(tmp_path / "nope"))
+
+
+class TestUriPlumbing:
+    def test_split_scheme(self):
+        assert fslib.split_scheme("gs://b/p") == ("gs", "b/p")
+        assert fslib.split_scheme("/a/b") == ("", "/a/b")
+        assert fslib.split_scheme("file:///a") == ("file", "/a")
+
+    def test_resolve_local_and_file(self):
+        assert isinstance(fslib.resolve("/tmp/x"), fslib.LocalFS)
+        assert isinstance(fslib.resolve("file:///tmp/x"), fslib.LocalFS)
+
+    def test_resolve_unknown_scheme(self):
+        with pytest.raises(fslib.EdlFsError):
+            fslib.resolve("s3://bucket/x")
+
+    def test_register_scheme(self, tmp_path):
+        fslib.register_scheme("fake", _cp_command_fs)
+        try:
+            assert isinstance(fslib.resolve("fake://x"), fslib.CommandFS)
+        finally:
+            fslib._SCHEMES.pop("fake")
+
+    def test_local_fs_rejects_remote_uri(self):
+        with pytest.raises(fslib.EdlFsError):
+            fslib.LocalFS().exists("gs://b/x")
+
+    def test_join_uri(self):
+        assert fslib.join_uri("gs://b/", "c", "d") == "gs://b/c/d"
+
+    def test_fetch_file_local_passthrough(self, tmp_path):
+        p = tmp_path / "f"
+        p.write_text("x")
+        assert fslib.fetch_file(str(p)) == str(p)
+        assert fslib.fetch_file(f"file://{p}") == str(p)
+
+    def test_fetch_file_remote_caches(self, tmp_path):
+        fslib.register_scheme("fake", fslib.LocalFS)
+        try:
+            src = tmp_path / "params.bin"
+            src.write_text("weights")
+            # LocalFS treats fake:// as... it rejects. Use a tiny shim.
+            class Shim(fslib.LocalFS):
+                @staticmethod
+                def _path(uri):
+                    return uri.split("://", 1)[1] if "://" in uri else uri
+            fslib.register_scheme("fake", Shim)
+            cache = tmp_path / "cache"
+            out = fslib.fetch_file(f"fake://{src}", str(cache))
+            assert open(out).read() == "weights"
+            # second fetch hits the cache (delete the source to prove it)
+            src.unlink()
+            assert fslib.fetch_file(f"fake://{src}", str(cache)) == out
+        finally:
+            fslib._SCHEMES.pop("fake")
+
+
+class TestCheckpointMirror:
+    def _state(self, value):
+        return {"w": np.full((4,), value, np.float32)}
+
+    def test_mirror_marker_last_and_fetch(self, tmp_path):
+        local, remote = str(tmp_path / "l"), str(tmp_path / "r")
+        os.makedirs(os.path.join(local, "ckpt-0"))
+        with open(os.path.join(local, "ckpt-0", "meta.json"), "w") as f:
+            json.dump({"version": 0}, f)
+        fslib.mirror_checkpoint(local, 0, remote)
+        assert fslib.remote_versions(remote) == [0]
+        dst = str(tmp_path / "cold")
+        assert fslib.fetch_latest_checkpoint(remote, dst) == 0
+        assert os.path.isfile(os.path.join(dst, "ckpt-0", "meta.json"))
+
+    def test_fetch_no_marker(self, tmp_path):
+        remote = str(tmp_path / "empty")
+        os.makedirs(remote)
+        assert fslib.fetch_latest_checkpoint(remote, str(tmp_path / "d")) is None
+
+    def test_mirror_keep_prunes_old(self, tmp_path):
+        local, remote = str(tmp_path / "l"), str(tmp_path / "r")
+        for v in range(3):
+            os.makedirs(os.path.join(local, f"ckpt-{v}"))
+            fslib.mirror_checkpoint(local, v, remote, keep=2)
+        assert fslib.remote_versions(remote) == [1, 2]
+
+    def test_manager_save_mirrors_and_cold_restore(self, tmp_path):
+        remote = str(tmp_path / "remote")
+        mgr = CheckpointManager(str(tmp_path / "podA"), process_index=0,
+                                remote=remote)
+        state = self._state(3.0)
+        mgr.save(state, TrainStatus(epoch=2, step=7, world_size=1))
+        mgr.save(self._state(5.0), TrainStatus(epoch=3, step=9, world_size=1))
+        assert fslib.remote_versions(remote) == [0, 1]
+        # a brand-new pod with an empty local dir restores from the mirror
+        cold = CheckpointManager(str(tmp_path / "podB"), process_index=0,
+                                 remote=remote)
+        out = cold.restore(self._state(0.0))
+        assert out is not None
+        restored, status = out
+        np.testing.assert_array_equal(restored["w"], self._state(5.0)["w"])
+        assert (status.epoch, status.step) == (3, 9)
+
+    def test_manager_restore_specific_version_from_mirror(self, tmp_path):
+        remote = str(tmp_path / "remote")
+        mgr = CheckpointManager(str(tmp_path / "podA"), process_index=0,
+                                remote=remote)
+        mgr.save(self._state(1.0), TrainStatus(epoch=0, step=1, world_size=1))
+        mgr.save(self._state(2.0), TrainStatus(epoch=1, step=2, world_size=1))
+        cold = CheckpointManager(str(tmp_path / "podB"), process_index=0,
+                                 remote=remote)
+        out = cold.restore(self._state(0.0), version=0)
+        assert out is not None
+        np.testing.assert_array_equal(out[0]["w"], self._state(1.0)["w"])
+
+    def test_restore_prefers_newer_remote_over_stale_local(self, tmp_path):
+        # a pod whose container restarted in place holds ckpt-0 locally
+        # while rank 0 mirrored ckpt-1 — restore must take the mirror's.
+        remote = str(tmp_path / "remote")
+        writer = CheckpointManager(str(tmp_path / "w"), process_index=0,
+                                   remote=remote)
+        writer.save(self._state(1.0), TrainStatus(epoch=0, step=1,
+                                                  world_size=1))
+        stale = CheckpointManager(str(tmp_path / "s"), process_index=0,
+                                  remote=remote)
+        assert stale.restore(self._state(0.0)) is not None  # pulls ckpt-0
+        writer.save(self._state(9.0), TrainStatus(epoch=1, step=2,
+                                                  world_size=1))
+        out = stale.restore(self._state(0.0))
+        assert out is not None
+        np.testing.assert_array_equal(out[0]["w"], self._state(9.0)["w"])
+        assert out[1].epoch == 1
+
+    def test_mirror_failure_is_not_fatal(self, tmp_path, monkeypatch):
+        mgr = CheckpointManager(str(tmp_path / "l"), process_index=0,
+                                remote=str(tmp_path / "r"))
+        monkeypatch.setattr(fslib, "mirror_checkpoint",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                fslib.EdlFsError("503")))
+        v = mgr.save(self._state(1.0), TrainStatus(epoch=0, step=0,
+                                                   world_size=1))
+        assert v == 0  # local save sealed despite the mirror failure
+        assert mgr.restore(self._state(0.0)) is not None
+
+    def test_manager_without_remote_unchanged(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "only"), process_index=0)
+        mgr.save(self._state(1.0), TrainStatus(epoch=0, step=0, world_size=1))
+        assert mgr.restore(self._state(0.0)) is not None
+
+    def test_sharded_save_mirrors(self, tmp_path):
+        # single-process sharded save still goes through _mirror
+        from edl_tpu.parallel.mesh import MeshSpec, make_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        remote = str(tmp_path / "remote")
+        mesh = make_mesh(MeshSpec({"dp": -1}))
+        sharding = NamedSharding(mesh, P())
+        arr = jax.device_put(np.arange(8, dtype=np.float32), sharding)
+        mgr = CheckpointManager(str(tmp_path / "l"), sharded=True,
+                                remote=remote)
+        mgr.save({"w": arr}, TrainStatus(epoch=0, step=0, world_size=1))
+        assert fslib.remote_versions(remote) == [0]
+        cold = CheckpointManager(str(tmp_path / "cold"), remote=remote)
+        target = jax.device_put(np.zeros(8, np.float32), sharding)
+        out = cold.restore({"w": target})
+        assert out is not None
+        np.testing.assert_array_equal(np.asarray(out[0]["w"]),
+                                      np.arange(8, dtype=np.float32))
