@@ -311,7 +311,8 @@ class TeacherClient:
 
 def _build_model_predict(model_name: str, num_classes: int, params_path: str,
                          input_key: str, output_key: str,
-                         input_shape: tuple[int, ...] = (32, 32, 3)):
+                         input_shape: tuple[int, ...] = (32, 32, 3),
+                         input_dtype: str = "float32"):
     """CLI helper: jitted zoo-model forward with random or restored params."""
     import jax
     import jax.numpy as jnp
@@ -325,7 +326,8 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
     # Dense layers bind their kernel to the flattened input size, so init
     # must see the shape that will be served.
     state = create_state(model, jax.random.PRNGKey(0), (1,) + input_shape,
-                         optax.identity())
+                         optax.identity(),
+                         input_dtype=jnp.dtype(input_dtype))
     if params_path:
         from edl_tpu.train.checkpoint import CheckpointManager
         from edl_tpu.utils.fs import split_scheme
@@ -350,8 +352,8 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
         return model.apply(variables, images, train=False)
 
     def predict(feeds):
-        logits = forward(jnp.asarray(feeds[input_key]))
-        return {output_key: np.asarray(logits, np.float32)}
+        feed = jnp.asarray(feeds[input_key]).astype(jnp.dtype(input_dtype))
+        return {output_key: np.asarray(forward(feed), np.float32)}
 
     return predict
 
@@ -372,12 +374,15 @@ def main(argv=None) -> int:
     parser.add_argument("--output-key", default="logits")
     parser.add_argument("--input-shape", default="32,32,3",
                         help="per-sample input shape, e.g. 28,28,1")
+    parser.add_argument("--input-dtype", default="float32",
+                        help="float32 for images, int32 for token ids")
     parser.add_argument("--max-batch", type=int, default=64)
     parser.add_argument("--max-wait-ms", type=float, default=2.0)
     args = parser.parse_args(argv)
     shape = tuple(int(x) for x in args.input_shape.split(","))
     predict = _build_model_predict(args.model, args.num_classes, args.params,
-                                   args.input_key, args.output_key, shape)
+                                   args.input_key, args.output_key, shape,
+                                   args.input_dtype)
     server = TeacherServer(predict, port=args.port, host=args.host,
                            max_batch=args.max_batch,
                            max_wait=args.max_wait_ms / 1000.0)

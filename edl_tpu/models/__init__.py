@@ -9,7 +9,7 @@ _LAZY = {
     "vgg": ("VGG", "VGG11", "VGG13", "VGG16", "VGG19"),
     "transformer": ("Transformer", "TransformerConfig"),
     "mlp": ("MLP", "mlp"),
-    "bow": ("BOWClassifier",),
+    "bow": ("BOWClassifier", "CNNClassifier"),
     "deepfm": ("DeepFM",),
 }
 

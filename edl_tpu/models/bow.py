@@ -1,0 +1,65 @@
+"""Text-classification students for NLP distillation.
+
+Capability of the reference's NLP distill students (example/distill/nlp/
+model.py:84-135 — a BOW model: padding-masked embedding sum -> softsign
+-> linear head; and a CNN variant: embedding -> width-3 conv -> pool ->
+masked softsign sum -> head), re-designed for TPU: fixed-length padded id
+batches (static shapes for XLA), bf16-friendly ops, and the head sized
+by `num_classes` so the same students serve the sentiment demo (2) and
+larger label sets.
+
+These are the *students* of the ERNIE->BOW pipeline: the teacher is any
+served model producing logits over the same classes (a transformer LM
+head here — the ERNIE stand-in), consumed through `DistillReader`.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _masked_sum(embedded: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """Sum token vectors where id != 0 (0 is the pad id)."""
+    mask = (ids != 0).astype(embedded.dtype)[..., None]
+    return jnp.sum(embedded * mask, axis=1)
+
+
+class BOWClassifier(nn.Module):
+    """Bag-of-words: embed -> masked sum -> softsign -> dense head."""
+
+    vocab_size: int = 30000
+    embed_dim: int = 128
+    num_classes: int = 2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids, train: bool = False):
+        emb = nn.Embed(self.vocab_size, self.embed_dim,
+                       dtype=self.dtype, name="embed")(ids)
+        pooled = nn.soft_sign(_masked_sum(emb, ids))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="head")(pooled)
+
+
+class CNNClassifier(nn.Module):
+    """Embed -> width-3 conv (relu) -> masked softsign sum -> head."""
+
+    vocab_size: int = 30000
+    embed_dim: int = 128
+    num_filters: int = 128
+    num_classes: int = 2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids, train: bool = False):
+        emb = nn.Embed(self.vocab_size, self.embed_dim,
+                       dtype=self.dtype, name="embed")(ids)
+        # NWC conv over the token axis — XLA maps this onto the MXU as a
+        # batched matmul; no NCHW transpose dance needed on TPU.
+        hidden = nn.relu(nn.Conv(self.num_filters, kernel_size=(3,),
+                                 padding="SAME", dtype=self.dtype,
+                                 name="conv")(emb))
+        pooled = nn.soft_sign(_masked_sum(hidden, ids))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        name="head")(pooled)

@@ -69,14 +69,15 @@ def accuracy_topk(logits: jax.Array, labels: jax.Array, k: int = 1
 
 
 def create_state(model, rng: jax.Array, input_shape: tuple,
-                 tx: optax.GradientTransformation) -> TrainState:
+                 tx: optax.GradientTransformation,
+                 input_dtype=jnp.float32) -> TrainState:
     """Init a TrainState for a flax classification model (BN-aware).
 
     Init runs under jit: eager init dispatches each layer op separately,
     which is pathologically slow over a remote-device tunnel.
     """
     variables = jax.jit(lambda r: model.init(
-        r, jnp.zeros(input_shape, jnp.float32), train=False))(rng)
+        r, jnp.zeros(input_shape, input_dtype), train=False))(rng)
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
     return TrainState.create(apply_fn=model.apply, params=params, tx=tx,
@@ -123,8 +124,9 @@ def make_classification_step(num_classes: int, *, smoothing: float = 0.0,
 
 def make_distill_step(num_classes: int, *, temperature: float = 1.0,
                       hard_weight: float = 0.0, smoothing: float = 0.0,
-                      donate: bool = True) -> Callable:
-    """Step for {'image','label','teacher_logits'} batches: KD loss
+                      donate: bool = True,
+                      input_key: str = "image") -> Callable:
+    """Step for {input_key,'label','teacher_logits'} batches: KD loss
     (+ optional hard-label CE mix). The student-side consumer of the
     DistillReader pipeline (reference distill/resnet train_with_fleet.py
     soft-label path)."""
@@ -134,11 +136,12 @@ def make_distill_step(num_classes: int, *, temperature: float = 1.0,
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
             logits, mutated = state.apply_fn(
-                variables, batch["image"], train=True,
+                variables, batch[input_key], train=True,
                 mutable=["batch_stats"])
             new_stats = mutated["batch_stats"]
         else:
-            logits = state.apply_fn(variables, batch["image"], train=True)
+            logits = state.apply_fn(variables, batch[input_key],
+                                    train=True)
             new_stats = None
         loss = distill_kl(logits, batch["teacher_logits"], temperature)
         if hard_weight > 0.0:
@@ -153,7 +156,7 @@ def make_distill_step(num_classes: int, *, temperature: float = 1.0,
     return make_train_step(loss_fn, donate=donate)
 
 
-def make_eval_step() -> Callable:
+def make_eval_step(input_key: str = "image") -> Callable:
     """Jitted eval: (state, batch) -> {'acc1','acc5'} (train=False)."""
 
     @jax.jit
@@ -161,8 +164,9 @@ def make_eval_step() -> Callable:
         variables = {"params": state.params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
-        logits = state.apply_fn(variables, batch["image"], train=False)
+        logits = state.apply_fn(variables, batch[input_key], train=False)
         return {"acc1": accuracy_topk(logits, batch["label"], 1),
-                "acc5": accuracy_topk(logits, batch["label"], 5)}
+                "acc5": accuracy_topk(logits, batch["label"],
+                                      min(5, logits.shape[-1]))}
 
     return eval_step

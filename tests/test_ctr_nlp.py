@@ -1,0 +1,163 @@
+"""BOW/CNN text students, DeepFM CTR model, and their example pipelines.
+
+Covers the reference's NLP-distill students (example/distill/nlp/model.py)
+and CTR model + file-dispensed training (example/ctr/ctr/train.py over the
+task master) at test scale.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from edl_tpu.models.bow import BOWClassifier, CNNClassifier
+from edl_tpu.models.deepfm import DeepFM, auc, bce_with_logits
+
+
+class TestTextModels:
+    @pytest.mark.parametrize("cls_", [BOWClassifier, CNNClassifier])
+    def test_forward_shapes(self, cls_):
+        model = cls_(vocab_size=100, embed_dim=16, num_classes=2)
+        ids = jnp.array([[1, 2, 3, 0, 0], [4, 5, 0, 0, 0]], jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), ids)
+        logits = model.apply(variables, ids)
+        assert logits.shape == (2, 2)
+
+    def test_padding_is_ignored(self):
+        """Appending pad ids must not change the logits (masked sum)."""
+        model = BOWClassifier(vocab_size=100, embed_dim=16)
+        short = jnp.array([[7, 8, 9, 0, 0, 0]], jnp.int32)
+        longer = jnp.array([[7, 8, 9, 0, 0, 0, 0, 0, 0, 0]], jnp.int32)
+        variables = model.init(jax.random.PRNGKey(0), short)
+        np.testing.assert_allclose(model.apply(variables, short),
+                                   model.apply(variables, longer), rtol=1e-6)
+
+    def test_bow_learns_band_task(self):
+        from edl_tpu.examples.nlp_distill import synthetic_sentiment
+        from edl_tpu.examples.nlp_distill import _fit, _pure_ce_step, _acc
+        from edl_tpu.train.classification import make_eval_step
+
+        data = synthetic_sentiment(1024, seed=0, noise=0.0)
+        model = BOWClassifier(vocab_size=4000, embed_dim=16)
+        state = _fit(model, data, epochs=6, batch_size=128, lr=3e-3, seed=0,
+                     step_builder=_pure_ce_step)
+        acc = _acc(state, data, make_eval_step(input_key="ids"))
+        assert acc > 0.8, acc
+
+
+class TestDeepFM:
+    def _batch(self, n=4):
+        rng = np.random.default_rng(0)
+        return (jnp.asarray(rng.normal(size=(n, 13)).astype(np.float32)),
+                jnp.asarray(rng.integers(0, 50, size=(n, 26), dtype=np.int32)))
+
+    def test_forward_shape(self):
+        model = DeepFM(vocab_size=50, embed_dim=4, hidden=(8,))
+        dense, sparse = self._batch()
+        variables = model.init(jax.random.PRNGKey(0), dense, sparse)
+        out = model.apply(variables, dense, sparse)
+        assert out.shape == (4, 1)
+
+    def test_fm_second_order_identity(self):
+        """FM term equals the explicit pairwise-dot sum."""
+        model = DeepFM(vocab_size=50, embed_dim=4, hidden=(8,))
+        dense, sparse = self._batch(2)
+        variables = model.init(jax.random.PRNGKey(0), dense, sparse)
+        emb = variables["params"]["sparse_embed"]["embedding"]
+        vecs = np.asarray(emb)[np.asarray(sparse)]  # (B, F, D)
+        explicit = np.zeros(2)
+        for b in range(2):
+            for i in range(26):
+                for j in range(i + 1, 26):
+                    explicit[b] += float(vecs[b, i] @ vecs[b, j])
+        s = vecs.sum(axis=1)
+        identity = 0.5 * ((s * s).sum(-1) - (vecs * vecs).sum(-1).sum(-1))
+        np.testing.assert_allclose(identity, explicit, rtol=1e-4)
+
+    def test_bce_matches_naive(self):
+        logits = jnp.array([-2.0, 0.0, 3.0])
+        labels = jnp.array([0.0, 1.0, 1.0])
+        p = jax.nn.sigmoid(logits)
+        naive = -jnp.mean(labels * jnp.log(p) + (1 - labels) * jnp.log(1 - p))
+        np.testing.assert_allclose(float(bce_with_logits(logits, labels)),
+                                   float(naive), rtol=1e-5)
+
+    def test_auc_known_values(self):
+        # perfect ranking -> 1.0; inverted -> 0.0; random-ish -> 0.5 w/ ties
+        assert auc([0.1, 0.2, 0.8, 0.9], [0, 0, 1, 1]) == 1.0
+        assert auc([0.9, 0.8, 0.2, 0.1], [1, 1, 0, 0]) == 1.0
+        assert auc([0.9, 0.8, 0.2, 0.1], [0, 0, 1, 1]) == 0.0
+        assert auc([0.5, 0.5, 0.5, 0.5], [0, 1, 0, 1]) == 0.5
+        assert np.isnan(auc([0.5, 0.4], [1, 1]))
+
+
+class TestCtrPipeline:
+    def test_ctr_train_end_to_end(self, tmp_path):
+        """Full CLI path: synthesize files, dispense via TaskMaster, train,
+        AUC improves over chance, benchmark log written."""
+        import json
+        from edl_tpu.examples.ctr_train import main
+
+        rc = main(["--data-dir", str(tmp_path / "data"),
+                   "--make-synthetic", "3", "--rows-per-file", "2048",
+                   "--epochs", "6", "--hidden", "64", "--lr", "3e-3",
+                   "--batch-size", "256",
+                   "--benchmark-log", str(tmp_path / "blog")])
+        assert rc == 0
+        blog = json.load(open(tmp_path / "blog" / "log_0.json"))
+        assert blog["model"] == "deepfm_ctr"
+        assert len(blog["epochs"]) == 6
+        assert blog["final"]["auc"] > 0.62, blog["final"]
+        assert blog["max_examples_per_sec"] > 0
+
+    def test_ctr_tasks_shared_across_trainers(self, tmp_path):
+        """Two TaskDataLoaders on one store split an epoch exactly-once."""
+        from edl_tpu.coord.store import InMemStore
+        from edl_tpu.data.task_loader import TaskDataLoader, npz_loader
+        from edl_tpu.data.task_master import TaskMaster, file_list_specs
+        from edl_tpu.examples.ctr_train import make_synthetic_files
+
+        import threading
+
+        files = make_synthetic_files(str(tmp_path), 4, 512)
+        store = InMemStore()
+        masters = [TaskMaster(store, "j", f"t{i}") for i in range(2)]
+        masters[0].init_epoch(0, file_list_specs(files))
+        loaders = [TaskDataLoader(m, npz_loader, 128, poll=0.05)
+                   for m in masters]
+        rows = [0, 0]
+
+        # one thread per trainer, like one process per pod: a loader may
+        # block polling for the last pending task, which must not stall
+        # the other trainer (the single-threaded round-robin version of
+        # this test deadlocks by construction until leases expire).
+        def run(i):
+            for batch in loaders[i].epoch(0):
+                rows[i] += len(batch["label"])
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert sum(rows) == 4 * 512  # every record exactly once
+        assert (loaders[0].tasks_completed + loaders[1].tasks_completed) == 4
+        assert loaders[0].tasks_lost == loaders[1].tasks_lost == 0
+        counts = masters[0].counts(0)
+        assert counts == {"todo": 0, "pending": 0, "done": 4, "failed": 0}
+
+
+class TestNlpDistillPipeline:
+    def test_distill_beats_alone(self):
+        """The full wire pipeline at tiny scale: teacher serves over TCP,
+        student distills through DistillReader; distilled student must not
+        be (much) worse than the from-scratch baseline and the pipeline
+        must complete cleanly."""
+        from edl_tpu.examples.nlp_distill import main
+
+        rc = main(["--all-in-one", "--samples", "512", "--epochs", "2",
+                   "--teacher-epochs", "2", "--distill-extra", "512",
+                   "--batch-size", "128", "--lr", "3e-3"])
+        assert rc == 0
