@@ -56,6 +56,96 @@ class ArraySource:
         return {k: v[idx] for k, v in self.arrays.items()}
 
 
+def _npz_rows(path: str) -> int:
+    """Row count of an .npz shard from the first member's .npy HEADER only
+    (NpzFile.__getitem__ would decompress the whole member — at dataset
+    scale that's a full read of every shard just to size the index)."""
+    import zipfile
+
+    from numpy.lib import format as npy_format
+
+    with zipfile.ZipFile(path) as zf:
+        names = [n for n in zf.namelist() if n.endswith(".npy")]
+        if not names:
+            raise EdlDataError(f"{path}: no arrays in npz")
+        with zf.open(names[0]) as f:
+            version = npy_format.read_magic(f)
+            try:
+                shape, _, _ = npy_format._read_array_header(f, version)
+            except AttributeError:  # private API moved: pay the full read
+                with np.load(path) as z:
+                    shape = z[z.files[0]].shape
+    if not shape:
+        raise EdlDataError(f"{path}: scalar array cannot be a data shard")
+    return int(shape[0])
+
+
+class FileSource:
+    """Random-access source over .npz shard files (file-backed ArraySource).
+
+    The file-backed input path of the reference's reader stack (a cv2/
+    DALI-class reader walks an image file list, reader_cv2.py) for the
+    deterministic loader: an index maps global row -> (file, local row);
+    whole shards load lazily on first touch and stay in a small LRU so a
+    shuffled epoch doesn't thrash (with shuffle, touches cluster by the
+    permutation's locality; size the cache to a few shards).
+
+    Files must share keys; per-file row counts come from reading only the
+    first member's .npy header (`_npz_rows`) so constructing the index
+    never loads shard data.
+    """
+
+    def __init__(self, files: Sequence[str], cache_files: int = 4):
+        if not files:
+            raise EdlDataError("FileSource needs at least one file")
+        if cache_files < 1:
+            raise EdlDataError(f"cache_files must be >= 1, got {cache_files}")
+        self.files = list(files)
+        self._counts = [_npz_rows(f) for f in self.files]
+        self._starts = np.cumsum([0] + self._counts)
+        self._cache: dict[int, dict[str, np.ndarray]] = {}
+        self._cache_order: list[int] = []
+        self.cache_files = cache_files
+
+    def __len__(self) -> int:
+        return int(self._starts[-1])
+
+    def _shard(self, fi: int) -> dict[str, np.ndarray]:
+        if fi in self._cache:
+            # LRU: refresh recency on hit so the hottest shard survives
+            self._cache_order.remove(fi)
+            self._cache_order.append(fi)
+            return self._cache[fi]
+        with np.load(self.files[fi]) as z:
+            self._cache[fi] = {k: z[k] for k in z.files}
+        self._cache_order.append(fi)
+        if len(self._cache_order) > self.cache_files:
+            del self._cache[self._cache_order.pop(0)]
+        return self._cache[fi]
+
+    def batch(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        fis = np.searchsorted(self._starts, idx, side="right") - 1
+        locals_ = idx - self._starts[fis]
+        out: dict[str, list] = {}
+        # group by file so each shard is touched once per batch
+        order = np.argsort(fis, kind="stable")
+        parts = []
+        for fi in np.unique(fis):
+            sel = order[fis[order] == fi]
+            shard = self._shard(int(fi))
+            parts.append((sel, {k: v[locals_[sel]]
+                                for k, v in shard.items()}))
+        keys = parts[0][1].keys()
+        n = len(idx)
+        for k in keys:
+            first = parts[0][1][k]
+            buf = np.empty((n,) + first.shape[1:], first.dtype)
+            for sel, arrs in parts:
+                buf[sel] = arrs[k]
+            out[k] = buf
+        return out
+
+
 class DataLoader:
     """Deterministic sharded batch iterator.
 

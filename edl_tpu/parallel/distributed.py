@@ -38,6 +38,12 @@ def force_platform_from_env() -> None:
     jax.config before the backend initializes. No-op once a backend
     exists or when the vars are unset.
     """
+    if _backends_initialized():
+        # config.update("jax_platforms") after backend init silently
+        # resets the backend cache (e.g. an 8-device CPU test world
+        # collapses to the 1-chip tunnel device) — enforce the no-op-
+        # once-initialized contract explicitly.
+        return
     plat = os.environ.get("JAX_PLATFORMS")
     ndev = os.environ.get("JAX_NUM_CPU_DEVICES", "").strip()
     try:
@@ -52,6 +58,14 @@ def force_platform_from_env() -> None:
             jax.config.update("jax_num_cpu_devices", ndev_i)
     except RuntimeError:  # backend already up — leave it be
         pass
+
+
+def _backends_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge
+        return xla_bridge.backends_are_initialized()
+    except Exception:  # private API moved — fall back to "assume not"
+        return False
 
 
 def init_from_env(env: TrainerEnv | None = None) -> TrainerEnv:

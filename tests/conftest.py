@@ -14,6 +14,13 @@ import os
 
 os.environ.setdefault("EDL_TPU_TEST_DEVICES", "8")
 
+# Keep the ambient env consistent with the config below: in-process code
+# that applies the env contract (parallel/distributed.py
+# force_platform_from_env, e.g. examples run inside tests) must re-apply
+# the SAME platform, not a sitecustomize tunnel backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_NUM_CPU_DEVICES"] = os.environ["EDL_TPU_TEST_DEVICES"]
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,120 @@
+"""FileSource (file-backed pipeline) + flagship imagenet_train example."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from edl_tpu.data.pipeline import ArraySource, DataLoader, FileSource
+from edl_tpu.utils.exceptions import EdlDataError
+
+
+def _write_shards(tmp_path, counts, dim=3, seed=0):
+    rng = np.random.default_rng(seed)
+    files, all_x, all_y = [], [], []
+    for i, n in enumerate(counts):
+        x = rng.normal(size=(n, dim)).astype(np.float32)
+        y = rng.integers(0, 5, size=n).astype(np.int32)
+        p = str(tmp_path / f"s{i}.npz")
+        np.savez(p, x=x, y=y)
+        files.append(p)
+        all_x.append(x)
+        all_y.append(y)
+    return files, np.concatenate(all_x), np.concatenate(all_y)
+
+
+class TestFileSource:
+    def test_matches_array_source(self, tmp_path):
+        files, x, y = _write_shards(tmp_path, [7, 5, 9])
+        fs = FileSource(files, cache_files=2)
+        assert len(fs) == 21
+        arr = ArraySource({"x": x, "y": y})
+        idx = np.array([0, 6, 7, 11, 12, 20, 3])  # spans all three files
+        got, want = fs.batch(idx), arr.batch(idx)
+        np.testing.assert_array_equal(got["x"], want["x"])
+        np.testing.assert_array_equal(got["y"], want["y"])
+
+    def test_loader_epoch_identical_to_in_memory(self, tmp_path):
+        files, x, y = _write_shards(tmp_path, [16, 16])
+        a = DataLoader(ArraySource({"x": x, "y": y}), 8, seed=3)
+        f = DataLoader(FileSource(files, cache_files=1), 8, seed=3)
+        for ba, bf in zip(a.epoch(2), f.epoch(2)):
+            np.testing.assert_array_equal(ba["x"], bf["x"])
+            np.testing.assert_array_equal(ba["y"], bf["y"])
+
+    def test_lru_eviction_correctness(self, tmp_path):
+        files, x, _ = _write_shards(tmp_path, [4, 4, 4, 4])
+        fs = FileSource(files, cache_files=1)
+        for idx in ([0, 5], [10, 15], [1, 14], [6, 9]):
+            got = fs.batch(np.array(idx))
+            np.testing.assert_array_equal(got["x"], x[np.array(idx)])
+        assert len(fs._cache) <= 1 + 1  # bounded
+
+    def test_empty_file_list_rejected(self):
+        with pytest.raises(EdlDataError):
+            FileSource([])
+
+    def test_zero_cache_rejected(self, tmp_path):
+        files, _, _ = _write_shards(tmp_path, [4])
+        with pytest.raises(EdlDataError):
+            FileSource(files, cache_files=0)
+
+    def test_lru_keeps_hot_shard(self, tmp_path):
+        files, _, _ = _write_shards(tmp_path, [4, 4, 4])
+        fs = FileSource(files, cache_files=2)
+        fs.batch(np.array([0]))   # load shard 0
+        fs.batch(np.array([5]))   # load shard 1
+        fs.batch(np.array([1]))   # HIT shard 0 -> refresh recency
+        fs.batch(np.array([9]))   # load shard 2 -> must evict 1, not 0
+        assert 0 in fs._cache and 1 not in fs._cache
+
+    def test_header_scan_counts(self, tmp_path):
+        from edl_tpu.data.pipeline import _npz_rows
+        files, _, _ = _write_shards(tmp_path, [7, 13])
+        assert [_npz_rows(f) for f in files] == [7, 13]
+
+
+class TestImagenetExample:
+    def test_end_to_end_learns_and_logs(self, tmp_path):
+        from edl_tpu.examples.imagenet_train import main
+
+        data = str(tmp_path / "data")
+        rc = main(["--data-dir", data, "--make-synthetic", "3",
+                   "--rows-per-file", "256", "--model", "ResNetTiny",
+                   "--num-classes", "10", "--image-size", "24",
+                   "--epochs", "3", "--batch-size", "64",
+                   "--warmup-epochs", "1", "--lr-strategy", "cosine",
+                   "--lr", "0.05", "--no-augment", "--label-smoothing", "0",
+                   "--ckpt-dir", str(tmp_path / "ckpt"),
+                   "--benchmark-log", str(tmp_path / "blog")])
+        assert rc == 0
+        blog = json.load(open(tmp_path / "blog" / "log_0.json"))
+        assert len(blog["epochs"]) == 3
+        assert blog["final"]["acc1"] > 0.8, blog["final"]
+        assert blog["max_examples_per_sec_global"] > 0
+        # checkpoints were written per epoch
+        assert any(n.startswith("ckpt-")
+                   for n in os.listdir(tmp_path / "ckpt"))
+
+    def test_resume_from_checkpoint(self, tmp_path):
+        """Second invocation resumes instead of restarting (elastic
+        restart path of the flagship trainer)."""
+        from edl_tpu.examples.imagenet_train import main
+
+        data = str(tmp_path / "data")
+        common = ["--data-dir", data, "--rows-per-file", "128",
+                  "--model", "ResNetTiny", "--num-classes", "5",
+                  "--image-size", "16", "--batch-size", "32",
+                  "--warmup-epochs", "1", "--lr-strategy", "cosine",
+                  "--lr", "0.03", "--no-augment",
+                  "--ckpt-dir", str(tmp_path / "ckpt")]
+        assert main(["--make-synthetic", "2", "--epochs", "1"] + common) == 0
+        versions = [n for n in os.listdir(tmp_path / "ckpt")
+                    if n.startswith("ckpt-")]
+        assert versions
+        # resume to epoch 2: must not error and must add a version
+        assert main(["--epochs", "2"] + common) == 0
+        versions2 = [n for n in os.listdir(tmp_path / "ckpt")
+                     if n.startswith("ckpt-")]
+        assert len(versions2) > len(versions)
