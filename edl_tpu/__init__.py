@@ -19,11 +19,15 @@ Layer map (ours; cf. reference SURVEY.md §1):
                  (reference: NCCL data plane -> XLA collectives over ICI)
     distill/     DistillReader + teacher discovery/balancing + TPU teacher server
                  (reference distill/, discovery/)
-    master/      elastic data-sharding task dispenser
-                 (reference pkg/master/service.go intent)
-    models/      ResNet50[_vd], VGG, BOW, DeepFM, transformer — flax
-    data/        sharded input pipelines, seed-per-pass shuffle
-    ops/         pallas TPU kernels
+    models/      ResNet50[_vd], VGG, BOW/CNN text, DeepFM, transformer — flax
+    data/        sharded input pipelines (in-memory / file / remote-served
+                 sources), elastic task-dispenser master + task data loader
+                 (reference pkg/master/service.go, utils/data_server.py),
+                 seed-per-pass shuffle
+    utils/       config/env overlay, logging, net, timeline profiler,
+                 remote FS (gs://, hdfs://) + checkpoint mirroring
+    examples/    fit_a_line, elastic/multipod demos, imagenet_train,
+                 mnist/nlp distill, ctr_train
 """
 
 __version__ = "0.1.0"

@@ -1,5 +1,6 @@
 from edl_tpu.coord.store import InMemStore, Record, Event, Store
 from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.lock import DistributedLock, LeaderElection
 from edl_tpu.coord.registry import ServiceRegistry, ServerMeta
 from edl_tpu.coord.consistent_hash import ConsistentHash
 
@@ -19,6 +20,8 @@ __all__ = [
     "Event",
     "StoreClient",
     "StoreServer",
+    "DistributedLock",
+    "LeaderElection",
     "ServiceRegistry",
     "ServerMeta",
     "ConsistentHash",

@@ -1,3 +1,4 @@
+from edl_tpu.data.data_server import DataServer, RemoteSource
 from edl_tpu.data.pipeline import (ArraySource, DataLoader, FileSource,
                                    epoch_indices, prefetch,
                                    prefetch_to_device)
@@ -5,6 +6,7 @@ from edl_tpu.data.task_loader import (TaskDataLoader, npz_loader,
                                       text_loader)
 from edl_tpu.data.task_master import TaskMaster, file_list_specs
 
-__all__ = ["ArraySource", "DataLoader", "FileSource", "epoch_indices",
-           "prefetch", "prefetch_to_device", "TaskDataLoader", "TaskMaster",
+__all__ = ["ArraySource", "DataLoader", "DataServer", "FileSource",
+           "RemoteSource", "epoch_indices", "prefetch",
+           "prefetch_to_device", "TaskDataLoader", "TaskMaster",
            "file_list_specs", "npz_loader", "text_loader"]
