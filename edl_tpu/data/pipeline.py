@@ -106,22 +106,29 @@ class FileSource:
         self._cache: dict[int, dict[str, np.ndarray]] = {}
         self._cache_order: list[int] = []
         self.cache_files = cache_files
+        # DataServer serves one source from a thread per connection; the
+        # LRU bookkeeping must not race across concurrent batch() calls.
+        self._cache_lock = threading.Lock()
 
     def __len__(self) -> int:
         return int(self._starts[-1])
 
     def _shard(self, fi: int) -> dict[str, np.ndarray]:
-        if fi in self._cache:
-            # LRU: refresh recency on hit so the hottest shard survives
-            self._cache_order.remove(fi)
-            self._cache_order.append(fi)
+        with self._cache_lock:
+            if fi in self._cache:
+                # LRU: refresh recency on hit so the hottest shard survives
+                self._cache_order.remove(fi)
+                self._cache_order.append(fi)
+                return self._cache[fi]
+        with np.load(self.files[fi]) as z:  # disk read outside the lock
+            arrays = {k: z[k] for k in z.files}
+        with self._cache_lock:
+            if fi not in self._cache:
+                self._cache[fi] = arrays
+                self._cache_order.append(fi)
+                if len(self._cache_order) > self.cache_files:
+                    del self._cache[self._cache_order.pop(0)]
             return self._cache[fi]
-        with np.load(self.files[fi]) as z:
-            self._cache[fi] = {k: z[k] for k in z.files}
-        self._cache_order.append(fi)
-        if len(self._cache_order) > self.cache_files:
-            del self._cache[self._cache_order.pop(0)]
-        return self._cache[fi]
 
     def batch(self, idx: np.ndarray) -> dict[str, np.ndarray]:
         fis = np.searchsorted(self._starts, idx, side="right") - 1

@@ -37,6 +37,10 @@ class TransformerConfig:
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    # attention kernel: "auto" = ring when the mesh has sp>1, else the
+    # Pallas flash kernel on TPU (ops/flash_attention.py), else XLA
+    # dense; "flash"/"dense" force a single-device kernel choice.
+    attention: str = "auto"
     # mesh: when set (and it has sp>1) attention runs the ring kernel and
     # activations get logical sharding constraints. None = single-device.
     mesh: Mesh | None = dfield(default=None, hash=False, compare=False)
@@ -54,6 +58,41 @@ class TransformerConfig:
     def use_ring(self) -> bool:
         return (self.mesh is not None and "sp" in self.mesh.axis_names
                 and self.mesh.shape["sp"] > 1)
+
+    def use_flash(self, seq_len: int) -> bool:
+        if self.attention not in ("auto", "flash", "dense"):
+            raise ValueError(f"unknown attention={self.attention!r} "
+                             "(auto|flash|dense)")
+        if self.attention == "flash":
+            return True
+        if self.attention != "auto":
+            return False
+        # auto: the Pallas kernel needs a TPU backend (interpret mode is
+        # for tests), a 128-divisible sequence, and a mesh without model
+        # sharding on heads (tp shards heads; flash is per-head so it
+        # composes, but XLA partitions the dense path equally well — keep
+        # flash for the unsharded-attention case where it clearly wins).
+        return (jax.default_backend() == "tpu" and seq_len % 128 == 0
+                and (self.mesh is None
+                     or all(self.mesh.shape.get(a, 1) == 1
+                            for a in ("tp", "sp"))))
+
+    def flash(self, q, k, v):
+        """Flash attention, shard_mapped over the mesh's batch axes —
+        a pallas_call is opaque to the XLA partitioner, so without this
+        a dp-sharded input would be gathered to every device."""
+        from edl_tpu.ops.flash_attention import flash_attention
+        if self.mesh is None or all(s == 1 for s in
+                                    self.mesh.shape.values()):
+            return flash_attention(q, k, v, causal=True)
+        from jax.sharding import PartitionSpec as P
+        batch = tuple(a for a in ("dp", "fsdp")
+                      if self.mesh.shape.get(a, 1) > 1) or None
+        spec = P(batch)
+        fn = partial(flash_attention, causal=True)
+        return jax.shard_map(fn, mesh=self.mesh,
+                             in_specs=(spec, spec, spec), out_specs=spec,
+                             check_vma=False)(q, k, v)
 
 
 def _dense(features, names, cfg, name=None):
@@ -88,6 +127,8 @@ class Attention(nn.Module):
 
         if cfg.use_ring:
             o = ra.ring_attention(q, k, v, mesh=cfg.mesh, causal=True)
+        elif cfg.use_flash(s):
+            o = cfg.flash(q, k, v)
         else:
             o = ra.dense_attention(q, k, v, causal=True)
         o = cfg.constrain(o, ("batch", "seq", "heads", "kv"))

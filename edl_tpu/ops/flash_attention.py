@@ -1,0 +1,216 @@
+"""Fused causal attention: Pallas TPU forward + blockwise XLA backward.
+
+The hot op of the transformer path, written for the hardware instead of
+leaving the S^2 score tensor to XLA: the kernel streams K/V blocks
+through VMEM against a resident Q block, keeping the online-softmax
+running (max, denominator) in registers/VMEM — scores never exist in HBM
+at any size, and the two matmuls per block land on the MXU with fp32
+accumulation. Causal skip: K/V blocks entirely in a Q block's future are
+never read (the standard flash-attention trick, halving the work).
+
+Backward: the flash recipe (Dao et al.) with the saved log-sum-exp and
+delta = rowsum(dO * O), recomputing scores blockwise under `lax.scan` in
+plain XLA — O(S * block) live memory, MXU-friendly matmuls, no Pallas
+needed for parity since the recompute is itself just matmuls XLA tiles
+well.
+
+Layout contract: (B, S, H, D) in, (B, S, H, D) out (the transformer's
+native layout; the kernel grid works on (B*H, S, D) views). On non-TPU
+backends the kernel runs in Pallas interpret mode, so CPU tests exercise
+the same code path bit-for-bit.
+
+No reference counterpart (its models are CNNs + served ERNIE); this is
+the tpu-first half of the long-context story, composing with
+parallel/ring_attention.py which shards S over the mesh and calls a
+per-shard attention on each block pair.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_k: int,
+                scale: float, causal: bool):
+    """One (batch*head, q-block) program: stream K/V blocks online.
+
+    q_ref: (1, BLK_Q, D); k_ref/v_ref: (1, S, D); o_ref: (1, BLK_Q, D);
+    lse_ref: (1, BLK_Q, 1) log-sum-exp for the backward (trailing 1 dim:
+    TPU block shapes need the last dims tileable-or-full).
+    """
+    _, blk_q, d = q_ref.shape
+    s = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    q_pos = qi * blk_q + lax.broadcasted_iota(jnp.int32, (blk_q, 1), 0)
+
+    def body(ki, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * blk_k, blk_k), :].astype(jnp.float32)
+        sblk = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            kv_pos = ki * blk_k + lax.broadcasted_iota(
+                jnp.int32, (1, blk_k), 1)
+            sblk = jnp.where(q_pos >= kv_pos, sblk, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sblk, axis=-1, keepdims=True))
+        p = jnp.exp(sblk - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jnp.dot(p, v_blk,
+                               preferred_element_type=jnp.float32)
+        return o, m_new, l
+
+    o0 = jnp.zeros((blk_q, d), jnp.float32)
+    m0 = jnp.full((blk_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((blk_q, 1), jnp.float32)
+    if causal:
+        # blocks strictly after this q block never contribute
+        n_blocks = lax.div((qi + 1) * blk_q + blk_k - 1, blk_k)
+    else:
+        n_blocks = s // blk_k
+    o, m, l = lax.fori_loop(0, n_blocks, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _fwd(q, k, v, *, blk_q: int, blk_k: int, scale: float, causal: bool,
+         interpret: bool):
+    b, s, h, d = q.shape
+    # (B, S, H, D) -> (B*H, S, D) program-per-head views
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    grid = (b * h, s // blk_q)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, blk_k=blk_k, scale=scale,
+                          causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, blk_q, 1), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    o = o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return o, lse[..., 0]
+
+
+def _bwd_blockwise(q, k, v, o, lse, do, *, blk: int, scale: float,
+                   causal: bool):
+    """Flash backward in plain XLA, scanning KV blocks. All (B,S,H,D)."""
+    b, s, h, d = q.shape
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    # delta_i = rowsum(dO_i * O_i)  (B,S,H)
+    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)
+    lse_b = lse.reshape(b, h, s).transpose(0, 2, 1)  # (B,S,H)
+
+    q_pos = jnp.arange(s)
+
+    def kv_step(carry, ki):
+        dq_acc = carry
+        ksl = lax.dynamic_slice_in_dim(k32, ki * blk, blk, axis=1)
+        vsl = lax.dynamic_slice_in_dim(v32, ki * blk, blk, axis=1)
+        # scores for ALL q rows vs this kv block: (B,H,S,blk)
+        sblk = jnp.einsum("bqhd,bkhd->bhqk", q32, ksl,
+                          preferred_element_type=jnp.float32) * scale
+        if causal:
+            kv_pos = ki * blk + jnp.arange(blk)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            sblk = jnp.where(mask[None, None], sblk, _NEG_INF)
+        p = jnp.exp(sblk - lse_b.transpose(0, 2, 1)[..., None])  # (B,H,S,blk)
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p, do32,
+                            preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", do32, vsl,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, ksl,
+                                     preferred_element_type=jnp.float32)
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q32,
+                            preferred_element_type=jnp.float32)
+        return dq_acc, (dk_blk, dv_blk)
+
+    n_blocks = s // blk
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        kv_step, jnp.zeros_like(q32), jnp.arange(n_blocks))
+    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
+    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, blk_q, blk_k, scale, causal):
+    interpret = jax.default_backend() != "tpu"
+    o, _ = _fwd(q, k, v, blk_q=blk_q, blk_k=blk_k, scale=scale,
+                causal=causal, interpret=interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, blk_q, blk_k, scale, causal):
+    interpret = jax.default_backend() != "tpu"
+    o, lse = _fwd(q, k, v, blk_q=blk_q, blk_k=blk_k, scale=scale,
+                  causal=causal, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(blk_q, blk_k, scale, causal, res, do):
+    q, k, v, o, lse = res
+    return _bwd_blockwise(q, k, v, o, lse, do, blk=blk_k, scale=scale,
+                          causal=causal)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _fit_block(s: int, want: int) -> int:
+    """Largest MXU-friendly block <= want that divides s (128-granular,
+    so any 128-divisible sequence works — e.g. S=640 gets 128 blocks)."""
+    if want >= s:
+        if s % 128 == 0 or s <= 512:
+            return s
+    for b in (want, 512, 384, 256, 128):
+        if b <= want and s % b == 0:
+            return b
+    raise ValueError(f"sequence {s} not divisible by any block size "
+                     f"<= {want} (pad the sequence to a multiple of 128)")
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: float | None = None,
+                    block_q: int = 512, block_k: int = 512) -> jax.Array:
+    """Fused causal attention. q/k/v: (B, S, H, D) -> (B, S, H, D).
+
+    Falls back to blocks that divide S; requires S % block == 0 after
+    clamping (pad the sequence to a multiple of 128 upstream — the
+    transformer's static max_len already guarantees this).
+    """
+    b, s, h, d = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shape mismatch: {q.shape} {k.shape} "
+                         f"{v.shape}")
+    blk_q = _fit_block(s, block_q)
+    blk_k = _fit_block(s, block_k)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    return _flash(q, k, v, blk_q, blk_k, scale, causal)

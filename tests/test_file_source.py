@@ -69,6 +69,34 @@ class TestFileSource:
         fs.batch(np.array([9]))   # load shard 2 -> must evict 1, not 0
         assert 0 in fs._cache and 1 not in fs._cache
 
+    def test_concurrent_batches_race_free(self, tmp_path):
+        """Thread-per-connection DataServer sharing one FileSource: the
+        LRU mutation must be lock-protected (regression: unlocked
+        _cache_order.remove raced to ValueError/KeyError)."""
+        import threading
+
+        files, x, _ = _write_shards(tmp_path, [64] * 6)
+        fs = FileSource(files, cache_files=2)
+        errors = []
+
+        def hammer(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                for _ in range(200):
+                    idx = rng.integers(0, len(fs), size=16)
+                    got = fs.batch(idx)
+                    np.testing.assert_array_equal(got["x"], x[idx])
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+
     def test_header_scan_counts(self, tmp_path):
         from edl_tpu.data.pipeline import _npz_rows
         files, _, _ = _write_shards(tmp_path, [7, 13])
