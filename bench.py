@@ -152,6 +152,39 @@ def bench_resnet(on_tpu: bool) -> dict:
             "vs_baseline": round(per_accel / (1828.0 / 8.0), 3)}
 
 
+def bench_flash_kernel(on_tpu: bool) -> dict:
+    """Pallas flash kernel vs XLA dense attention at long context.
+
+    Kernel-level number (the transformer bench exercises it end-to-end):
+    forward speedup at S=4096, where the causal block skip and the
+    never-materialized score tensor matter most."""
+    from edl_tpu.ops.flash_attention import flash_attention
+    from edl_tpu.parallel.ring_attention import dense_attention
+
+    if on_tpu:
+        B, S, H, D, steps = 4, 4096, 16, 64, 10
+    else:
+        B, S, H, D, steps = 1, 512, 2, 64, 2
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, S, H, D),
+                                 jnp.bfloat16) for i in range(3))
+    f_flash = jax.jit(lambda q, k, v: flash_attention(q, k, v,
+                                                      block_q=1024))
+    f_dense = jax.jit(lambda q, k, v: dense_attention(q, k, v))
+
+    def timed(fn) -> float:
+        fn(q, k, v).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(q, k, v)
+        out.block_until_ready()
+        return time.perf_counter() - t0
+
+    t_flash, t_dense = timed(f_flash), timed(f_dense)
+    return {"seq_len": S,
+            "speedup_vs_dense": round(t_dense / t_flash, 2)}
+
+
 def bench_transformer(on_tpu: bool) -> dict:
     """Causal LM train step: tokens/s + MFU vs the chip's bf16 peak."""
     from edl_tpu.models.transformer import (Transformer, TransformerConfig,
@@ -341,6 +374,7 @@ def main() -> None:
     on_tpu = jax.devices()[0].platform == "tpu"
     resnet = bench_resnet(on_tpu)
     transformer = bench_transformer(on_tpu)
+    flash = bench_flash_kernel(on_tpu)
     distill = bench_distill(on_tpu)
     print(json.dumps({
         "metric": "resnet50_vd_train_imgs_per_sec",
@@ -353,6 +387,8 @@ def main() -> None:
             "resnet_pipeline_imgs_per_sec": resnet["pipeline_imgs_per_sec"],
             "transformer_tokens_per_sec": transformer["tokens_per_sec"],
             "transformer_mfu": transformer["mfu"],
+            "flash_attn_speedup": flash["speedup_vs_dense"],
+            "flash_attn_seq_len": flash["seq_len"],
             "distill_student_imgs_per_sec": distill["imgs_per_sec"],
             "distill_vs_colocated_baseline":
                 distill["vs_colocated_baseline"],
