@@ -77,9 +77,14 @@ def make_synthetic_shards(data_dir: str, n_files: int, rows: int,
 
 
 def build_schedule(args, steps_per_epoch: int, world: int) -> optax.Schedule:
-    """The reference's LR menu (train_with_fleet.py:114-225), world-scaled
-    (linear scaling rule, edl_collective_design_doc.md:14-16)."""
-    base = lr_lib.scale_for_world(args.lr, 1, world)
+    """The reference's LR menu (train_with_fleet.py:114-225).
+
+    --batch-size is GLOBAL, so the LR is tied to the batch, not the
+    world: an elastic resize keeps the same optimization (the linear
+    scaling rule, edl_collective_design_doc.md:14-16, applies when the
+    TOTAL batch grows with the trainer count — scale --lr yourself if
+    you also scale --batch-size)."""
+    base = args.lr
     warmup = args.warmup_epochs * steps_per_epoch
     total = args.epochs * steps_per_epoch
     if args.lr_strategy == "cosine":

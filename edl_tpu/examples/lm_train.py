@@ -120,8 +120,10 @@ def main(argv=None) -> int:
                         seed=args.seed)
     steps_per_epoch = loader.steps_per_epoch()
     total_steps = steps_per_epoch * args.epochs
+    # --batch-size is GLOBAL: LR stays batch-tied across elastic resizes
+    # (scale_for_world is for per-pod batch semantics)
     schedule = lr_lib.cosine_with_warmup(
-        lr_lib.scale_for_world(args.lr, 1, world), total_steps,
+        args.lr, total_steps,
         min(args.warmup_steps, max(1, total_steps // 10)))
     tx = optax.adamw(schedule, weight_decay=0.01)
 

@@ -1,0 +1,89 @@
+"""Flagship imagenet trainer under a real multi-process world.
+
+multipod_demo proves the one-world mechanics on a linear model; this
+proves the FLAGSHIP trainer (file-backed FileSource input, BN stats,
+label pipeline, benchmark log) trains correctly when two launcher-style
+processes form one jax.distributed world. Because each rank feeds
+`perm[rank::world]` of the same seed-per-pass global order, every global
+step consumes the same sample SET as a single-process run with the
+global batch — so accuracy must match up to reduction order.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+from edl_tpu.utils import net
+
+TRAINER = "edl_tpu.examples.imagenet_train"
+
+
+def cpu_env(extra=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"JAX_PLATFORMS": "cpu", "JAX_NUM_CPU_DEVICES": "1"})
+    env.update(extra or {})
+    return env
+
+
+def run_world(tmp_path, tag, world, data_dir, epochs=4, timeout=300):
+    port = net.free_port()
+    blog_dir = tmp_path / f"blog-{tag}"
+    procs, logs = [], []
+    for rank in range(world):
+        env = cpu_env({
+            "EDL_TPU_RANK": str(rank),
+            "EDL_TPU_WORLD_SIZE": str(world),
+            "EDL_TPU_COORDINATOR": f"127.0.0.1:{port}",
+        })
+        logs.append(open(tmp_path / f"{tag}.r{rank}.log", "wb"))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", TRAINER, "--data-dir", str(data_dir),
+             "--model", "ResNetTiny", "--num-classes", "8",
+             "--image-size", "16", "--epochs", str(epochs),
+             "--batch-size", "32", "--warmup-epochs", "1",
+             "--lr-strategy", "cosine", "--lr", "0.05", "--no-augment",
+             "--label-smoothing", "0",
+             "--benchmark-log", str(blog_dir)],
+            env=env, stdout=logs[-1], stderr=subprocess.STDOUT))
+    deadline = time.time() + timeout
+    try:
+        for rank, p in enumerate(procs):
+            rc = p.wait(timeout=max(1.0, deadline - time.time()))
+            assert rc == 0, (tmp_path / f"{tag}.r{rank}.log").read_text()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+    with open(blog_dir / "log_0.json") as f:
+        return json.load(f)
+
+
+def test_flagship_two_process_world_matches_single(tmp_path):
+    # generate shards once (single process, deterministic)
+    data_dir = tmp_path / "data"
+    rc = subprocess.run(
+        [sys.executable, "-m", TRAINER, "--data-dir", str(data_dir),
+         "--make-synthetic", "2", "--rows-per-file", "128",
+         "--model", "ResNetTiny", "--num-classes", "8",
+         "--image-size", "16", "--epochs", "0", "--batch-size", "32"],
+        env=cpu_env(), capture_output=True)
+    assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
+
+    solo = run_world(tmp_path, "solo", 1, data_dir)
+    duo = run_world(tmp_path, "duo", 2, data_dir)
+    assert solo["world_size"] == 1 and duo["world_size"] == 2
+    acc_s = solo["final"]["acc1"]
+    acc_d = duo["final"]["acc1"]
+    # the task is learnable; both worlds must learn it and agree
+    assert acc_s > 0.8, solo["final"]
+    assert acc_d > 0.8, duo["final"]
+    assert abs(acc_s - acc_d) < 0.1, (solo["final"], duo["final"])
+    # global throughput figure uses the world multiplier
+    assert duo["max_examples_per_sec_global"] > duo["max_examples_per_sec"]
