@@ -27,7 +27,7 @@ import optax
 
 from edl_tpu.data.pipeline import DataLoader, FileSource
 from edl_tpu.models.transformer import (Transformer, TransformerConfig,
-                                        lm_loss_fn)
+                                        lm_loss_fn, lm_loss_fused)
 from edl_tpu.parallel import distributed, mesh as mesh_lib, sharding as shd
 from edl_tpu.train import lr as lr_lib
 from edl_tpu.train.benchlog import BenchmarkLog
@@ -76,6 +76,10 @@ def main(argv=None) -> int:
     parser.add_argument("--lr", type=float, default=3e-4)
     parser.add_argument("--warmup-steps", type=int, default=100)
     parser.add_argument("--bf16", action="store_true")
+    parser.add_argument("--fused-loss", action="store_true",
+                        help="streamed-vocab CE: never materializes the "
+                             "(B,S,V) logits (ops/fused_xent.py) — use "
+                             "when the vocab is large")
     parser.add_argument("--fsdp", action="store_true",
                         help="shard params over the fsdp axis (else dp)")
     parser.add_argument("--ckpt-dir", default="")
@@ -133,7 +137,8 @@ def main(argv=None) -> int:
                            train=False), mesh)
     state = TrainState.create(apply_fn=model.apply,
                               params=variables["params"], tx=tx)
-    step = make_train_step(lm_loss_fn, donate=True)
+    step = make_train_step(lm_loss_fused if args.fused_loss else lm_loss_fn,
+                           donate=True)
     log.info("world=%d rank=%d devices=%d params=%s steps/epoch=%d",
              world, rank, jax.device_count(),
              sum(p.size for p in jax.tree.leaves(state.params)),
@@ -145,7 +150,10 @@ def main(argv=None) -> int:
         with np.load(val_path) as z:
             eval_toks = z["tokens"][: 4 * local_bs]
 
-    eval_step = jax.jit(lambda s, b: lm_loss_fn(s, s.params, b)[0])
+    # eval must honor the fused path too — the dense loss would
+    # materialize exactly the logits tensor --fused-loss exists to avoid
+    eval_loss_fn = lm_loss_fused if args.fused_loss else lm_loss_fn
+    eval_step = jax.jit(lambda s, b: eval_loss_fn(s, s.params, b)[0])
     blog = BenchmarkLog(f"transformer_lm_{args.d_model}d{args.n_layers}L",
                         batch_size=args.batch_size, world_size=world)
     epoch_t0 = [time.perf_counter()]

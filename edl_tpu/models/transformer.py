@@ -169,7 +169,13 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True):
+    def __call__(self, tokens, train: bool = True,
+                 return_hidden: bool = False):
+        """return_hidden=True skips the lm_head and yields the final-LN
+        hidden states (B, S, d) — the input of the streamed-vocab fused
+        CE (ops/fused_xent.py), which reads the head kernel straight
+        from the param tree. Init must use the default path so the
+        lm_head params exist."""
         cfg = self.cfg
         # Table axes use the dedicated (vocab_table, embed_table) logical
         # names: vocab stays unsharded so the token gather partitions
@@ -194,6 +200,8 @@ class Transformer(nn.Module):
         for i in range(cfg.n_layers):
             x = block(cfg, name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_final")(x)
+        if return_hidden:
+            return x
         # Tied-untied head: separate projection, fp32 logits for stable CE.
         logits = nn.DenseGeneral(
             cfg.vocab_size, axis=-1, dtype=jnp.float32, use_bias=False,
@@ -212,4 +220,21 @@ def lm_loss_fn(state, params, batch):
     logp = jax.nn.log_softmax(logits)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     loss = -jnp.mean(ll)
+    return loss, {"ppl": jnp.exp(loss)}
+
+
+def lm_loss_fused(state, params, batch, *, chunk: int = 8192):
+    """lm_loss_fn without the (B,S,V) logits tensor: hidden states feed
+    the streamed-vocab CE (ops/fused_xent.py), which reads the lm_head
+    kernel from the param tree. Numerically equivalent to lm_loss_fn;
+    use for large-vocab models where the logits dominate memory."""
+    from edl_tpu.ops.fused_xent import streamed_lm_xent
+
+    hidden = state.apply_fn({"params": params}, batch["tokens"],
+                            train=True, return_hidden=True)
+    b, s, d = hidden.shape
+    hidden = hidden[:, :-1].reshape(b * (s - 1), d)
+    targets = batch["tokens"][:, 1:].reshape(-1)
+    kernel = params["lm_head"]["kernel"]
+    loss = streamed_lm_xent(hidden, kernel, targets, chunk)
     return loss, {"ppl": jnp.exp(loss)}

@@ -1,3 +1,4 @@
 from edl_tpu.ops.flash_attention import flash_attention
+from edl_tpu.ops.fused_xent import streamed_lm_xent
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "streamed_lm_xent"]
