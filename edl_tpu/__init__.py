@@ -20,6 +20,7 @@ Layer map (ours; cf. reference SURVEY.md §1):
     distill/     DistillReader + teacher discovery/balancing + TPU teacher server
                  (reference distill/, discovery/)
     models/      ResNet50[_vd], VGG, BOW/CNN text, DeepFM, transformer — flax
+    ops/         TPU kernels: Pallas flash attention, streamed-vocab CE
     data/        sharded input pipelines (in-memory / file / remote-served
                  sources), elastic task-dispenser master + task data loader
                  (reference pkg/master/service.go, utils/data_server.py),

@@ -120,6 +120,11 @@ def main(argv=None) -> int:
     parser.add_argument("--warmup-epochs", type=int, default=5)
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--weight-decay", type=float, default=1e-4)
+    parser.add_argument("--dgc-sparsity", type=float, default=0.0,
+                        help="deep gradient compression: fraction of "
+                             "gradient entries dropped (0 = off; the "
+                             "reference's use_dgc flag)")
+    parser.add_argument("--dgc-rampup-epochs", type=int, default=1)
     parser.add_argument("--label-smoothing", type=float, default=0.1)
     parser.add_argument("--mixup-alpha", type=float, default=0.0)
     parser.add_argument("--bf16", action="store_true",
@@ -172,9 +177,20 @@ def main(argv=None) -> int:
     model = zoo.get_model(args.model)(num_classes=args.num_classes,
                                       dtype=dtype)
     schedule = build_schedule(args, steps_per_epoch, world)
-    tx = optax.chain(
-        optax.add_decayed_weights(args.weight_decay),
-        optax.sgd(schedule, momentum=args.momentum, nesterov=False))
+    if args.dgc_sparsity > 0:
+        from edl_tpu.train.dgc import dgc
+        # DGC's momentum correction REPLACES optimizer momentum, and
+        # weight decay stays dense (applied after the compressor) so
+        # regularization strength is uniform, not send-frequency-tied.
+        tx = optax.chain(
+            dgc(sparsity=args.dgc_sparsity, momentum=args.momentum,
+                rampup_steps=args.dgc_rampup_epochs * steps_per_epoch),
+            optax.add_decayed_weights(args.weight_decay),
+            optax.sgd(schedule))
+    else:
+        tx = optax.chain(
+            optax.add_decayed_weights(args.weight_decay),
+            optax.sgd(schedule, momentum=args.momentum, nesterov=False))
     state = create_state(model, jax.random.PRNGKey(args.seed),
                          (1, args.image_size, args.image_size, 3), tx)
     step = make_classification_step(args.num_classes,

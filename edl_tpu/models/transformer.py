@@ -227,7 +227,13 @@ def lm_loss_fused(state, params, batch, *, chunk: int = 8192):
     """lm_loss_fn without the (B,S,V) logits tensor: hidden states feed
     the streamed-vocab CE (ops/fused_xent.py), which reads the lm_head
     kernel from the param tree. Numerically equivalent to lm_loss_fn;
-    use for large-vocab models where the logits dominate memory."""
+    use for large-vocab models where the logits dominate memory.
+
+    Mesh note: intended for dp/fsdp worlds (kernel replicated or sharded
+    on the embed dim — the contraction reduces it with a psum). Under
+    tp the head kernel is sharded on the VOCAB dim, and the chunked
+    dynamic_slice would make XLA gather the full table — use the dense
+    lm_loss_fn there (its vocab-parallel softmax partitions cleanly)."""
     from edl_tpu.ops.fused_xent import streamed_lm_xent
 
     hidden = state.apply_fn({"params": params}, batch["tokens"],
