@@ -44,16 +44,19 @@ def _topk_threshold(flat: jnp.ndarray, keep_frac: float) -> jnp.ndarray:
     """|value| threshold keeping ~keep_frac of entries.
 
     Exact k-th-largest for small leaves; for big leaves the threshold is
-    estimated from a strided sample (the DGC paper's recipe) — a full
+    estimated from a RANDOM sample (the DGC paper's recipe) — a full
     per-leaf per-step top_k is a sort over millions of entries on the
-    hot path, while the sampled estimate is O(sample log sample) and
-    hits the budget within noise."""
+    hot path. The sample uses fixed-seed uniform indices: a strided
+    sample would alias with the tensor's inner dimensions (e.g. pick a
+    handful of columns of a (R, C) kernel) and bias the threshold by
+    orders of magnitude under per-channel scale structure."""
     n = flat.size
     if n <= _SAMPLE_CAP:
         k = max(1, int(round(n * keep_frac)))
         return jax.lax.top_k(jnp.abs(flat), k)[0][-1]
-    stride = n // _SAMPLE_CAP
-    sample = jnp.abs(flat[:: stride][:_SAMPLE_CAP])
+    idx = jax.random.randint(jax.random.PRNGKey(n % (2**31 - 1)),
+                             (_SAMPLE_CAP,), 0, n)
+    sample = jnp.abs(flat[idx])
     k = max(1, int(round(sample.size * keep_frac)))
     return jax.lax.top_k(sample, k)[0][-1]
 
@@ -123,9 +126,12 @@ def dgc(sparsity: float = 0.99, momentum: float = 0.9,
             return jax.tree.map(
                 lambda d, s: jnp.where(in_rampup, d, s), dense, sparse)
 
-        out = select(updates, sent)
-        # during ramp-up the buffers stay empty (dense pass-through)
-        u_out = select(jax.tree.map(jnp.zeros_like, u_new), u_kept)
+        # Ramp-up emits the momentum-CORRECTED update densely (u carries
+        # across steps = heavyball momentum, matching the reference's
+        # DGCMomentum staying a momentum optimizer pre-rampup); raw
+        # pass-through would silently train momentum-free early epochs.
+        out = select(u_new, sent)
+        u_out = select(u_new, u_kept)
         v_out = select(jax.tree.map(jnp.zeros_like, v_new), v_kept)
         return out, DGCState(step=step, momentum=u_out, residual=v_out)
 

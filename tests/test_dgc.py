@@ -100,7 +100,7 @@ class TestDgc:
 
     def test_sampled_threshold_hits_budget(self):
         """Leaves above the sample cap estimate the threshold from a
-        strided sample — the kept fraction must stay near the budget."""
+        random sample — the kept fraction must stay near the budget."""
         from edl_tpu.train.dgc import _SAMPLE_CAP
         n = _SAMPLE_CAP * 8
         tx = dgc(sparsity=0.99)
@@ -108,6 +108,41 @@ class TestDgc:
         out, _ = tx.update(g, tx.init(g))
         kept = int(jnp.sum(out["w"] != 0)) / n
         assert 0.003 < kept < 0.03, kept  # ~1% within sampling noise
+
+    def test_sampling_unbiased_under_structure(self):
+        """Regression: a strided sample aliases with the inner dims of
+        structured tensors (per-channel scales) and skews the threshold
+        by orders of magnitude; random sampling must hold the budget."""
+        from edl_tpu.train.dgc import _SAMPLE_CAP
+        tx = dgc(sparsity=0.99)
+        # (R, C) kernel where a few columns are 100x larger
+        r, c = 64, 1024  # n = 65536 > cap
+        w = jax.random.normal(jax.random.PRNGKey(1), (r, c))
+        w = w.at[:, ::256].multiply(100.0)
+        out, _ = tx.update({"w": w}, tx.init({"w": w}))
+        kept = int(jnp.sum(out["w"] != 0)) / w.size
+        assert 0.002 < kept < 0.05, kept
+        # prefix-structured leaf just above the cap (old stride=1 bug
+        # sampled only the large-magnitude prefix)
+        n = _SAMPLE_CAP + 4000
+        v = jnp.concatenate([
+            100.0 * jax.random.normal(jax.random.PRNGKey(2),
+                                      (_SAMPLE_CAP,)),
+            jax.random.normal(jax.random.PRNGKey(3), (4000,))])
+        out2, _ = tx.update({"w": v}, tx.init({"w": v}))
+        kept2 = int(jnp.sum(out2["w"] != 0)) / n
+        assert 0.002 < kept2 < 0.05, kept2
+
+    def test_rampup_is_momentum_corrected(self):
+        """Ramp-up must emit heavyball-momentum updates (buffers carry),
+        not raw gradients — matching the reference's DGCMomentum."""
+        tx = dgc(sparsity=0.99, momentum=0.9, rampup_steps=10)
+        g = {"w": jnp.ones((128,))}
+        state = tx.init(g)
+        out1, state = tx.update(g, state)
+        out2, state = tx.update(g, state)
+        np.testing.assert_allclose(np.asarray(out1["w"]), 1.0)
+        np.testing.assert_allclose(np.asarray(out2["w"]), 1.9)  # 0.9*1+1
 
 
 class TestSparsePsum:
