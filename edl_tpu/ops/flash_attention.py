@@ -115,8 +115,14 @@ def _fwd(q, k, v, *, blk_q: int, blk_k: int, scale: float, causal: bool,
 
 
 def _bwd_blockwise(q, k, v, o, lse, do, *, blk: int, scale: float,
-                   causal: bool):
-    """Flash backward in plain XLA, scanning KV blocks. All (B,S,H,D)."""
+                   causal: bool, dlse=None):
+    """Flash backward in plain XLA, scanning KV blocks. All (B,S,H,D).
+
+    With `dlse` (a (B*H, S) cotangent on the log-sum-exp output), the
+    score gradient gains the softmax term: d(lse)/d(s_ij) = p_ij, so
+    ds += p * dlse_row — this is what lets consumers of (o, lse)
+    (the lse-combine in ring attention) differentiate through both.
+    """
     b, s, h, d = q.shape
     q32 = q.astype(jnp.float32)
     k32 = k.astype(jnp.float32)
@@ -125,6 +131,8 @@ def _bwd_blockwise(q, k, v, o, lse, do, *, blk: int, scale: float,
     # delta_i = rowsum(dO_i * O_i)  (B,S,H)
     delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)
     lse_b = lse.reshape(b, h, s).transpose(0, 2, 1)  # (B,S,H)
+    dlse_bh = (None if dlse is None
+               else dlse.reshape(b, h, s).astype(jnp.float32))  # (B,H,S)
 
     q_pos = jnp.arange(s)
 
@@ -144,7 +152,12 @@ def _bwd_blockwise(q, k, v, o, lse, do, *, blk: int, scale: float,
                             preferred_element_type=jnp.float32)
         dp = jnp.einsum("bqhd,bkhd->bhqk", do32, vsl,
                         preferred_element_type=jnp.float32)
-        ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * scale
+        # dL/ds_ij = p_ij * (dp_ij - delta_i + dlse_i); the trailing
+        # *scale converts to the gradient w.r.t. the unscaled q.k
+        row_term = delta.transpose(0, 2, 1)[..., None]
+        if dlse_bh is not None:
+            row_term = row_term - dlse_bh[..., None]
+        ds = p * (dp - row_term) * scale
         dq_acc = dq_acc + jnp.einsum("bhqk,bkhd->bqhd", ds, ksl,
                                      preferred_element_type=jnp.float32)
         dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q32,
@@ -157,30 +170,6 @@ def _bwd_blockwise(q, k, v, o, lse, do, *, blk: int, scale: float,
     dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
     dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, blk_q, blk_k, scale, causal):
-    interpret = jax.default_backend() != "tpu"
-    o, _ = _fwd(q, k, v, blk_q=blk_q, blk_k=blk_k, scale=scale,
-                causal=causal, interpret=interpret)
-    return o
-
-
-def _flash_fwd(q, k, v, blk_q, blk_k, scale, causal):
-    interpret = jax.default_backend() != "tpu"
-    o, lse = _fwd(q, k, v, blk_q=blk_q, blk_k=blk_k, scale=scale,
-                  causal=causal, interpret=interpret)
-    return o, (q, k, v, o, lse)
-
-
-def _flash_bwd(blk_q, blk_k, scale, causal, res, do):
-    q, k, v, o, lse = res
-    return _bwd_blockwise(q, k, v, o, lse, do, blk=blk_k, scale=scale,
-                          causal=causal)
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _fit_block(s: int, want: int) -> int:
@@ -196,14 +185,40 @@ def _fit_block(s: int, want: int) -> int:
                      f"<= {want} (pad the sequence to a multiple of 128)")
 
 
-def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = True, scale: float | None = None,
-                    block_q: int = 512, block_k: int = 512) -> jax.Array:
-    """Fused causal attention. q/k/v: (B, S, H, D) -> (B, S, H, D).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, blk_q, blk_k, scale, causal):
+    interpret = jax.default_backend() != "tpu"
+    o, lse = _fwd(q, k, v, blk_q=blk_q, blk_k=blk_k, scale=scale,
+                  causal=causal, interpret=interpret)
+    return o, lse
 
-    Falls back to blocks that divide S; requires S % block == 0 after
-    clamping (pad the sequence to a multiple of 128 upstream — the
-    transformer's static max_len already guarantees this).
+
+def _flash_lse_fwd(q, k, v, blk_q, blk_k, scale, causal):
+    interpret = jax.default_backend() != "tpu"
+    o, lse = _fwd(q, k, v, blk_q=blk_q, blk_k=blk_k, scale=scale,
+                  causal=causal, interpret=interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(blk_q, blk_k, scale, causal, res, cotangents):
+    q, k, v, o, lse = res
+    do, dlse = cotangents
+    return _bwd_blockwise(q, k, v, o, lse, do, blk=blk_k, scale=scale,
+                          causal=causal, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = True, scale: float | None = None,
+                        block_q: int = 512, block_k: int = 512
+                        ) -> tuple[jax.Array, jax.Array]:
+    """flash_attention that ALSO returns the per-row log-sum-exp
+    ((B, H*... reshaped) -> (B, S, H)) — the combinable statistic for
+    composing partial attentions (ring attention's per-block kernel:
+    two normalized outputs merge exactly via their lse weights).
+    Fully differentiable through both outputs.
     """
     b, s, h, d = q.shape
     if k.shape != q.shape or v.shape != q.shape:
@@ -213,4 +228,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     blk_k = _fit_block(s, block_k)
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    return _flash(q, k, v, blk_q, blk_k, scale, causal)
+    o, lse = _flash_lse(q, k, v, blk_q, blk_k, scale, causal)
+    return o, lse.reshape(b, h, s).transpose(0, 2, 1)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True, scale: float | None = None,
+                    block_q: int = 512, block_k: int = 512) -> jax.Array:
+    """Fused causal attention. q/k/v: (B, S, H, D) -> (B, S, H, D).
+
+    Blocks auto-fit any 128-divisible sequence (pad upstream otherwise —
+    the transformer's static max_len already guarantees this). One
+    custom_vjp serves this and `flash_attention_lse`: the unused lse
+    output's cotangent is zero, which `_bwd_blockwise` folds away.
+    """
+    return flash_attention_lse(q, k, v, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k)[0]

@@ -79,15 +79,83 @@ def _local_ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.transpose(o, (0, 2, 1, 3)).astype(q.dtype)     # (B,S,H,D)
 
 
+def _local_ring_flash(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool, scale: float
+                      ) -> jax.Array:
+    """Ring body whose per-block attention is the flash kernel.
+
+    Each ring step runs `flash_attention_lse` on (q_local, kv_block) —
+    O(S_local * flash_block) live memory instead of the dense body's
+    S_local^2 score block — and merges the normalized partial outputs
+    by their log-sum-exp weights (the exact blockwise-softmax combine).
+    Global causality decides the block's kernel mode: past blocks are
+    dense-allowed (causal=False), the diagonal block is causal, future
+    blocks contribute nothing.
+    """
+    from edl_tpu.ops.flash_attention import flash_attention_lse
+
+    axis_size = lax.psum(1, axis_name)
+    my_index = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+
+    def past(q, kb, vb):
+        o, lse = flash_attention_lse(q, kb, vb, causal=False, scale=scale)
+        # fp32 so all switch branches (incl. `future`) agree for bf16 io
+        return o.astype(jnp.float32), lse
+
+    def diag(q, kb, vb):
+        o, lse = flash_attention_lse(q, kb, vb, causal=True, scale=scale)
+        return o.astype(jnp.float32), lse
+
+    def future(q, kb, vb):
+        return (jnp.zeros(q.shape, jnp.float32),
+                jnp.full((b, s_local, h), _NEG_INF, jnp.float32))
+
+    def combine(o, lse, o_b, lse_b):
+        o_b = o_b.astype(jnp.float32)
+        m = jnp.maximum(lse, lse_b)
+        safe = m > _NEG_INF / 2
+        w1 = jnp.where(safe, jnp.exp(lse - m), 0.0)
+        w2 = jnp.where(safe, jnp.exp(lse_b - m), 0.0)
+        den = jnp.maximum(w1 + w2, 1e-30)
+        o_new = (o * w1[..., None] + o_b * w2[..., None]) / den[..., None]
+        lse_new = jnp.where(safe, m + jnp.log(den), m)
+        return o_new, lse_new
+
+    def step(carry, i):
+        o, lse, k_blk, v_blk = carry
+        src = (my_index - i) % axis_size
+        case = jnp.where(src == my_index, 0,
+                         jnp.where(src < my_index, 1, 2))
+        if causal:
+            o_b, lse_b = lax.switch(case, (diag, past, future),
+                                    q, k_blk, v_blk)
+        else:
+            o_b, lse_b = past(q, k_blk, v_blk)
+        o, lse = combine(o, lse, o_b, lse_b)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        return (o, lse, lax.ppermute(k_blk, axis_name, perm=perm),
+                lax.ppermute(v_blk, axis_name, perm=perm)), None
+
+    o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    lse0 = jnp.full((b, s_local, h), _NEG_INF, jnp.float32)
+    (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v),
+                               jnp.arange(axis_size))
+    return o.astype(q.dtype)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    mesh: Mesh, sp_axis: str = "sp",
                    batch_axes: Sequence[str] = ("dp", "fsdp"),
                    head_axis: str = "tp", causal: bool = True,
-                   scale: float | None = None) -> jax.Array:
+                   scale: float | None = None,
+                   use_flash: bool = False) -> jax.Array:
     """Global-view ring attention. q/k/v: (B, S, H, D), S sharded on sp_axis.
 
     Call under jit with global arrays; shard_map splits them so each device
     holds its sequence block, heads additionally sharded over `head_axis`.
+    `use_flash=True` runs the flash kernel per block pair (O(S_local*blk)
+    memory instead of S_local^2; enable on TPU for long local blocks).
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -96,7 +164,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     heads = head_axis if (head_axis in mesh.axis_names
                           and mesh.shape[head_axis] > 1) else None
     spec = P(batch, sp_axis, heads)
-    fn = functools.partial(_local_ring_attention, axis_name=sp_axis,
+    body = _local_ring_flash if use_flash else _local_ring_attention
+    fn = functools.partial(body, axis_name=sp_axis,
                            causal=causal, scale=scale)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

@@ -77,9 +77,11 @@ def dgc(sparsity: float = 0.99, momentum: float = 0.9,
         compressed — matching the reference's behavior of leaving tiny
         params dense.
       momentum: DGC's local momentum factor for the correction buffer.
-      rampup_steps: steps before compression engages (gradients pass
-        through unmodified while the model is in its noisy early phase —
-        the reference's rampup_begin_step).
+      rampup_steps: steps before compression engages. During ramp-up the
+        transform emits DENSE momentum-corrected (heavyball) updates —
+        i.e. it already acts as the momentum optimizer, matching the
+        reference's DGCMomentum pre-rampup — and the residual stays
+        empty (the reference's rampup_begin_step).
     """
     if not 0.0 <= sparsity < 1.0:
         raise ValueError(f"sparsity must be in [0, 1), got {sparsity}")

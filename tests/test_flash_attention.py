@@ -116,6 +116,44 @@ class TestBackward:
         assert grad.shape == q.shape
 
 
+class TestLseOutput:
+    def _oracle(self, q, k, v, s):
+        scale = 1.0 / q.shape[-1] ** 0.5
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+        sc = jnp.where(mask[None, None], sc, -1e30)
+        lse = jax.scipy.special.logsumexp(sc, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", jnp.exp(sc - lse[..., None]), v)
+        return o, lse.transpose(0, 2, 1)
+
+    def test_lse_values(self):
+        from edl_tpu.ops.flash_attention import flash_attention_lse
+        q, k, v = _qkv(s=128)
+        o, lse = flash_attention_lse(q, k, v, block_q=64, block_k=64)
+        oo, lo = self._oracle(q, k, v, 128)
+        np.testing.assert_allclose(o, oo, atol=2e-5)
+        np.testing.assert_allclose(lse, lo, atol=2e-5)
+
+    def test_lse_cotangent_flows(self):
+        """Gradients through BOTH outputs (the ring-combine consumes
+        lse differentiably) must match the dense oracle."""
+        from edl_tpu.ops.flash_attention import flash_attention_lse
+        q, k, v = _qkv(s=128)
+
+        def loss(fn):
+            def f(q, k, v):
+                o, lse = fn(q, k, v)
+                return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(lse))
+            return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        gf = loss(lambda q, k, v: flash_attention_lse(q, k, v,
+                                                      block_q=64,
+                                                      block_k=64))
+        go = loss(lambda q, k, v: self._oracle(q, k, v, 128))
+        for a, b in zip(gf, go):
+            np.testing.assert_allclose(a, b, atol=5e-5)
+
+
 class TestTransformerIntegration:
     def test_flash_config_matches_dense_config(self):
         """Same weights, attention='flash' (interpret) vs 'dense'."""
