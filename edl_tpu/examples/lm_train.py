@@ -80,8 +80,13 @@ def main(argv=None) -> int:
                         help="streamed-vocab CE: never materializes the "
                              "(B,S,V) logits (ops/fused_xent.py) — use "
                              "when the vocab is large")
+    parser.add_argument("--mesh", choices=("dp", "fsdp", "sp"),
+                        default="dp",
+                        help="dp: data parallel; fsdp: params sharded; "
+                             "sp: sequence parallel — ring attention over "
+                             "the sequence axis (long-context mode)")
     parser.add_argument("--fsdp", action="store_true",
-                        help="shard params over the fsdp axis (else dp)")
+                        help=argparse.SUPPRESS)  # legacy alias of --mesh fsdp
     parser.add_argument("--ckpt-dir", default="")
     parser.add_argument("--ckpt-sharded", action="store_true")
     parser.add_argument("--benchmark-log", default="")
@@ -109,10 +114,24 @@ def main(argv=None) -> int:
         raise SystemExit("global batch not divisible by world")
     local_bs = args.batch_size // world
 
-    if args.fsdp:
-        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"fsdp": -1}))
-    else:
-        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": -1}))
+    if args.fsdp and args.mesh != "dp":
+        raise SystemExit("--fsdp is a legacy alias of --mesh fsdp; "
+                         f"it conflicts with --mesh {args.mesh}")
+    kind = "fsdp" if args.fsdp else args.mesh
+    if kind == "sp":
+        if world > 1:
+            # rank-sharded loading + replicate_host_tree assume a data
+            # axis; an sp-only mesh would feed divergent "replicated"
+            # batches across processes — corrupt, not slow.
+            raise SystemExit("--mesh sp is single-process long-context "
+                             "mode; combine sp with dp/fsdp axes for "
+                             "multi-pod (see parallel/mesh.MeshSpec)")
+        n_dev = jax.device_count()
+        if args.seq_len % n_dev:
+            raise SystemExit(f"--mesh sp shards the sequence over "
+                             f"{n_dev} devices; --seq-len {args.seq_len} "
+                             f"is not divisible by {n_dev}")
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({kind: -1}))
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,

@@ -23,6 +23,18 @@ class TestLmTrain:
         assert blog["final"]["eval_loss"] < 4.2, blog["final"]
         assert blog["final"]["tokens_per_sec"] > 0
 
+    def test_sequence_parallel_mesh(self, tmp_path):
+        """--mesh sp: ring attention over the 8-device sequence axis
+        through the CLI (long-context mode)."""
+        from edl_tpu.examples.lm_train import main
+
+        rc = main(["--data-dir", str(tmp_path / "d"), "--make-synthetic",
+                   "1", "--rows-per-file", "64", "--vocab", "64",
+                   "--seq-len", "64", "--d-model", "32", "--n-heads", "2",
+                   "--n-layers", "1", "--d-ff", "64", "--epochs", "1",
+                   "--batch-size", "16", "--mesh", "sp"])
+        assert rc == 0
+
     def test_resume(self, tmp_path):
         from edl_tpu.examples.lm_train import main
 
