@@ -83,10 +83,14 @@ def build_schedule(args, steps_per_epoch: int, world: int) -> optax.Schedule:
     world: an elastic resize keeps the same optimization (the linear
     scaling rule, edl_collective_design_doc.md:14-16, applies when the
     TOTAL batch grows with the trainer count — scale --lr yourself if
-    you also scale --batch-size)."""
+    you also scale --batch-size). The schedule horizon is
+    --schedule-epochs (default --epochs) so a phase that stops early —
+    an elastic segment resumed later — still follows the SAME decay
+    curve as the full run."""
     base = args.lr
     warmup = args.warmup_epochs * steps_per_epoch
-    total = args.epochs * steps_per_epoch
+    horizon = args.schedule_epochs or args.epochs
+    total = horizon * steps_per_epoch
     if args.lr_strategy == "cosine":
         return lr_lib.cosine_with_warmup(base, total, warmup)
     boundaries = [int(e) * steps_per_epoch for e in args.lr_boundaries]
@@ -107,7 +111,12 @@ def main(argv=None) -> int:
                              "ResNetTiny, ...")
     parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--image-size", type=int, default=224)
-    parser.add_argument("--epochs", type=int, default=90)
+    parser.add_argument("--epochs", type=int, default=90,
+                        help="train (or resume) up to this epoch")
+    parser.add_argument("--schedule-epochs", type=int, default=0,
+                        help="LR schedule horizon (default --epochs); set "
+                             "to the job's TOTAL epochs when running an "
+                             "elastic segment that stops early")
     parser.add_argument("--batch-size", type=int, default=256,
                         help="GLOBAL batch size")
     parser.add_argument("--lr", type=float, default=0.1,

@@ -30,9 +30,13 @@ def cpu_env(extra=None):
     return env
 
 
-def run_world(tmp_path, tag, world, data_dir, epochs=4, timeout=300):
+def run_world(tmp_path, tag, world, data_dir, epochs=4, timeout=300,
+              ckpt=None, schedule_epochs=0):
     port = net.free_port()
     blog_dir = tmp_path / f"blog-{tag}"
+    extra = ["--ckpt-dir", str(ckpt)] if ckpt else []
+    if schedule_epochs:
+        extra += ["--schedule-epochs", str(schedule_epochs)]
     procs, logs = [], []
     for rank in range(world):
         env = cpu_env({
@@ -48,7 +52,7 @@ def run_world(tmp_path, tag, world, data_dir, epochs=4, timeout=300):
              "--batch-size", "32", "--warmup-epochs", "1",
              "--lr-strategy", "cosine", "--lr", "0.05", "--no-augment",
              "--label-smoothing", "0",
-             "--benchmark-log", str(blog_dir)],
+             "--benchmark-log", str(blog_dir)] + extra,
             env=env, stdout=logs[-1], stderr=subprocess.STDOUT))
     deadline = time.time() + timeout
     try:
@@ -65,8 +69,8 @@ def run_world(tmp_path, tag, world, data_dir, epochs=4, timeout=300):
         return json.load(f)
 
 
-def test_flagship_two_process_world_matches_single(tmp_path):
-    # generate shards once (single process, deterministic)
+def make_data(tmp_path):
+    """Generate shards once (single process, deterministic)."""
     data_dir = tmp_path / "data"
     rc = subprocess.run(
         [sys.executable, "-m", TRAINER, "--data-dir", str(data_dir),
@@ -75,7 +79,11 @@ def test_flagship_two_process_world_matches_single(tmp_path):
          "--image-size", "16", "--epochs", "0", "--batch-size", "32"],
         env=cpu_env(), capture_output=True)
     assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
+    return data_dir
 
+
+def test_flagship_two_process_world_matches_single(tmp_path):
+    data_dir = make_data(tmp_path)
     solo = run_world(tmp_path, "solo", 1, data_dir)
     duo = run_world(tmp_path, "duo", 2, data_dir)
     assert solo["world_size"] == 1 and duo["world_size"] == 2
@@ -87,3 +95,34 @@ def test_flagship_two_process_world_matches_single(tmp_path):
     assert abs(acc_s - acc_d) < 0.1, (solo["final"], duo["final"])
     # global throughput figure uses the world multiplier
     assert duo["max_examples_per_sec_global"] > duo["max_examples_per_sec"]
+
+
+def test_two_resizes_under_one_percent_acc_loss(tmp_path):
+    """The BASELINE north-star clause: a real model surviving >= 2
+    elastic resizes with < 1% acc1 loss vs the unresized run.
+
+    World sequence 2 -> 1 -> 2, each phase resuming the shared
+    checkpoint with --schedule-epochs pinned to the job's total (so all
+    phases ride the SAME 5-epoch cosine curve), compared against a
+    straight world=1 run of the same total epochs. The per-phase
+    benchmark logs also prove each phase RESUMED (it trained only its
+    own epochs) — a silent restore failure would otherwise make the
+    comparison vacuous.
+    """
+    data_dir = make_data(tmp_path)
+    ckpt = tmp_path / "ckpt"
+    p1 = run_world(tmp_path, "p1", 2, data_dir, epochs=2, ckpt=ckpt,
+                   schedule_epochs=5)
+    p2 = run_world(tmp_path, "p2", 1, data_dir, epochs=3, ckpt=ckpt,
+                   schedule_epochs=5)                            # resize 1
+    resized = run_world(tmp_path, "p3", 2, data_dir, epochs=5,
+                        ckpt=ckpt, schedule_epochs=5)            # resize 2
+    straight = run_world(tmp_path, "straight", 1, data_dir, epochs=5)
+    # resumes really happened: each phase trained only its own epochs
+    assert [e["epoch"] for e in p1["epochs"]] == [0, 1]
+    assert [e["epoch"] for e in p2["epochs"]] == [2]
+    assert [e["epoch"] for e in resized["epochs"]] == [3, 4]
+    acc_r = resized["final"]["acc1"]
+    acc_s = straight["final"]["acc1"]
+    assert acc_s > 0.85, straight["final"]
+    assert abs(acc_r - acc_s) < 0.01, (resized["final"], straight["final"])
