@@ -114,9 +114,11 @@ def main(argv=None) -> int:
     parser.add_argument("--epochs", type=int, default=90,
                         help="train (or resume) up to this epoch")
     parser.add_argument("--schedule-epochs", type=int, default=0,
-                        help="LR schedule horizon (default --epochs); set "
-                             "to the job's TOTAL epochs when running an "
-                             "elastic segment that stops early")
+                        help="cosine-strategy LR horizon (default "
+                             "--epochs); set to the job's TOTAL epochs "
+                             "when an elastic segment stops early "
+                             "(piecewise boundaries are absolute epochs "
+                             "already, so it does not apply there)")
     parser.add_argument("--batch-size", type=int, default=256,
                         help="GLOBAL batch size")
     parser.add_argument("--lr", type=float, default=0.1,
@@ -146,6 +148,12 @@ def main(argv=None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
+    if 0 < args.schedule_epochs < args.epochs:
+        raise SystemExit(
+            f"--schedule-epochs {args.schedule_epochs} < --epochs "
+            f"{args.epochs}: epochs past the horizon would train at "
+            "LR ~0 (the horizon is the job TOTAL; the stop point is "
+            "--epochs)")
     distributed.force_platform_from_env()
     env = distributed.init_from_env()
     world = max(1, env.world_size)

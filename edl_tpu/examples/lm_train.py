@@ -71,6 +71,9 @@ def main(argv=None) -> int:
     parser.add_argument("--n-layers", type=int, default=4)
     parser.add_argument("--d-ff", type=int, default=1024)
     parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--schedule-epochs", type=int, default=0,
+                        help="LR horizon (default --epochs); pin to the "
+                             "job's total for elastic segments")
     parser.add_argument("--batch-size", type=int, default=32,
                         help="GLOBAL batch size")
     parser.add_argument("--lr", type=float, default=3e-4)
@@ -93,6 +96,12 @@ def main(argv=None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
+    if 0 < args.schedule_epochs < args.epochs:
+        raise SystemExit(
+            f"--schedule-epochs {args.schedule_epochs} < --epochs "
+            f"{args.epochs}: epochs past the horizon would train at "
+            "LR ~0 (the horizon is the job TOTAL; the stop point is "
+            "--epochs)")
     distributed.force_platform_from_env()
     env = distributed.init_from_env()
     world = max(1, env.world_size)
@@ -142,7 +151,7 @@ def main(argv=None) -> int:
     loader = DataLoader(source, local_bs, rank=rank, world=world,
                         seed=args.seed)
     steps_per_epoch = loader.steps_per_epoch()
-    total_steps = steps_per_epoch * args.epochs
+    total_steps = steps_per_epoch * (args.schedule_epochs or args.epochs)
     # --batch-size is GLOBAL: LR stays batch-tied across elastic resizes
     # (scale_for_world is for per-pod batch semantics)
     schedule = lr_lib.cosine_with_warmup(
