@@ -56,25 +56,38 @@ class ArraySource:
         return {k: v[idx] for k, v in self.arrays.items()}
 
 
-def _npz_rows(path: str) -> int:
-    """Row count of an .npz shard from the first member's .npy HEADER only
-    (NpzFile.__getitem__ would decompress the whole member — at dataset
-    scale that's a full read of every shard just to size the index)."""
+def _npz_meta(path: str, first_only: bool = False
+              ) -> dict[str, tuple[tuple[int, ...], np.dtype]]:
+    """{key: (shape, dtype)} of an .npz shard from the members' .npy
+    HEADERS only (NpzFile.__getitem__ would decompress whole members —
+    at dataset scale that's a full read of every shard just to size the
+    index). `first_only` stops after one member — all a row count needs."""
     import zipfile
 
     from numpy.lib import format as npy_format
 
+    out: dict[str, tuple[tuple[int, ...], np.dtype]] = {}
     with zipfile.ZipFile(path) as zf:
         names = [n for n in zf.namelist() if n.endswith(".npy")]
         if not names:
             raise EdlDataError(f"{path}: no arrays in npz")
-        with zf.open(names[0]) as f:
-            version = npy_format.read_magic(f)
-            try:
-                shape, _, _ = npy_format._read_array_header(f, version)
-            except AttributeError:  # private API moved: pay the full read
-                with np.load(path) as z:
-                    shape = z[z.files[0]].shape
+        for name in names[:1] if first_only else names:
+            with zf.open(name) as f:
+                version = npy_format.read_magic(f)
+                try:
+                    shape, _, dtype = npy_format._read_array_header(
+                        f, version)
+                except AttributeError:  # private API moved: full read
+                    with np.load(path) as z:
+                        arr = z[name[:-4]]
+                        shape, dtype = arr.shape, arr.dtype
+            out[name[:-4]] = (tuple(shape), np.dtype(dtype))
+    return out
+
+
+def _npz_rows(path: str) -> int:
+    """Row count of an .npz shard (header of the first member only)."""
+    shape = next(iter(_npz_meta(path, first_only=True).values()))[0]
     if not shape:
         raise EdlDataError(f"{path}: scalar array cannot be a data shard")
     return int(shape[0])
@@ -105,6 +118,7 @@ class FileSource:
         self._starts = np.cumsum([0] + self._counts)
         self._cache: dict[int, dict[str, np.ndarray]] = {}
         self._cache_order: list[int] = []
+        self._meta: dict[str, tuple[tuple[int, ...], np.dtype]] | None = None
         self.cache_files = cache_files
         # DataServer serves one source from a thread per connection; the
         # LRU bookkeeping must not race across concurrent batch() calls.
@@ -131,6 +145,17 @@ class FileSource:
             return self._cache[fi]
 
     def batch(self, idx: np.ndarray) -> dict[str, np.ndarray]:
+        idx = np.asarray(idx)
+        if len(idx) == 0:
+            # Empty request (e.g. a remote DataServer client asking for
+            # zero rows) gets empty arrays of the right shapes/dtypes,
+            # not an IndexError from parts[0] below. Header-only scan,
+            # parsed once — loading a shard here would churn the LRU
+            # for zero rows.
+            if self._meta is None:
+                self._meta = _npz_meta(self.files[0])
+            return {k: np.empty((0,) + shape[1:], dtype)
+                    for k, (shape, dtype) in self._meta.items()}
         fis = np.searchsorted(self._starts, idx, side="right") - 1
         locals_ = idx - self._starts[fis]
         out: dict[str, list] = {}

@@ -44,6 +44,7 @@ from edl_tpu.utils.logging import get_logger
 log = get_logger("edl_tpu.train.checkpoint")
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+_INDEX_FILE_RE = re.compile(r"^index\.(\d+)\.json$")
 
 
 class CheckpointManager:
@@ -161,8 +162,9 @@ class CheckpointManager:
         # (otherwise the healthy ranks hang in it until the coordination
         # timeout); it drops a poison marker so every rank raises after.
         failure: BaseException | None = None
+        my_files: list[str] = []
         try:
-            sc.save_sharded(tmp, state)
+            my_files = sc.save_sharded(tmp, state)
         except BaseException as exc:  # noqa: BLE001 — re-raised below
             failure = exc
             try:
@@ -182,6 +184,8 @@ class CheckpointManager:
                 raise failure
             raise RuntimeError(
                 f"sharded save aborted: {poisoned} failed")
+        if self.remote is not None:
+            self._mirror_sharded_upload(tmp, version, my_files)
         try:
             if self.process_index == 0:
                 meta = {"version": version, "status": status.to_dict(),
@@ -198,9 +202,72 @@ class CheckpointManager:
             return None
         log.info("saved sharded checkpoint %s (epoch=%d step=%d)",
                  self._path(version), status.epoch, status.step)
-        self._mirror(version)
+        if self.remote is not None:
+            self._mirror_sharded_finalize(version)
         self._gc()
         return version
+
+    def _mirror_sharded_upload(self, tmp: str, version: int,
+                               my_files: list[str]) -> None:
+        """EVERY process uploads its own chunks + index from its pending
+        dir (local dirs need not be shared across pods); rank 0 uploads
+        meta.json + flips LATEST only in `_mirror_sharded_finalize`, so
+        the marker is last world-wide."""
+        from edl_tpu.utils import fs
+        if self.process_index == 0:
+            # A crashed earlier save at this version (possibly a
+            # different world shape) may have left stale chunks/indexes
+            # in the remote dir; merging them in would corrupt the
+            # restore — same hazard the local tmp-clean guards against.
+            try:
+                fs.resolve(self.remote).delete(
+                    fs.join_uri(self.remote, f"ckpt-{version}"))
+            except Exception as exc:  # noqa: BLE001 — mirror-only
+                log.warning("remote clean of ckpt-%d failed: %s",
+                            version, exc)
+        self._sync("edl_ckpt_mirror_clean")
+        try:
+            fs.mirror_checkpoint_files(tmp, version, self.remote, my_files)
+        except Exception as exc:  # noqa: BLE001 — any transfer error
+            # Swallow so this rank still reaches the barrier (a raw
+            # OSError from LocalFS would strand the world in _sync). The
+            # missing index.{rank}.json is what the finalize gate keys
+            # on, so LATEST never flips to this incomplete version.
+            log.warning("sharded mirror of ckpt-%d (rank %d) failed: %s",
+                        version, self.process_index, exc)
+        self._sync("edl_ckpt_mirror")
+
+    def _mirror_sharded_finalize(self, version: int) -> None:
+        """Rank 0 only. NOT `_mirror`: a whole-dir upload would replace
+        the remote version dir, wiping the other ranks' uploads."""
+        from edl_tpu.utils import fs
+        try:
+            # Completeness gate before the LATEST flip: the remote dir
+            # must hold EXACTLY index.{0..world-1}.json. A rank's index
+            # uploads last (save_sharded returns it last), so presence
+            # implies its chunks made it; an UNEXPECTED extra index —
+            # survivor of a failed remote clean, e.g. from a crashed
+            # save at a different world shape — would merge stale chunks
+            # into every restore, so it also blocks the flip. Skipping
+            # the flip keeps LATEST on the previous complete version
+            # (and skips its GC).
+            have = set(fs.resolve(self.remote).listdir(
+                fs.join_uri(self.remote, f"ckpt-{version}")))
+            want = {f"index.{r}.json" for r in range(jax.process_count())}
+            got = {n for n in have if _INDEX_FILE_RE.match(n)}
+            if got != want:
+                log.warning(
+                    "mirror of ckpt-%d inconsistent (missing indexes %s, "
+                    "stale extras %s) — LATEST not flipped", version,
+                    sorted(want - got), sorted(got - want))
+                return
+            fs.mirror_checkpoint_files(self._path(version), version,
+                                       self.remote, ["meta.json"])
+            fs.finalize_mirror(self.remote, version, keep=self.max_to_keep)
+            log.info("mirrored sharded ckpt-%d -> %s", version, self.remote)
+        except Exception as exc:  # noqa: BLE001 — a mirror failure must
+            log.warning("mirror of ckpt-%d to %s failed: %s", version,
+                        self.remote, exc)  # not kill a sealed local save
 
     def _gc(self) -> None:
         versions = self.versions()

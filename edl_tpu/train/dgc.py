@@ -40,22 +40,26 @@ from jax import lax
 _SAMPLE_CAP = 16384
 
 
-def _topk_threshold(flat: jnp.ndarray, keep_frac: float) -> jnp.ndarray:
+def _topk_threshold(flat: jnp.ndarray, keep_frac: float,
+                    step: jnp.ndarray) -> jnp.ndarray:
     """|value| threshold keeping ~keep_frac of entries.
 
     Exact k-th-largest for small leaves; for big leaves the threshold is
     estimated from a RANDOM sample (the DGC paper's recipe) — a full
     per-leaf per-step top_k is a sort over millions of entries on the
-    hot path. The sample uses fixed-seed uniform indices: a strided
-    sample would alias with the tensor's inner dimensions (e.g. pick a
-    handful of columns of a (R, C) kernel) and bias the threshold by
-    orders of magnitude under per-channel scale structure."""
+    hot path. The sample is uniform (a strided sample would alias with
+    the tensor's inner dimensions — e.g. pick a handful of columns of a
+    (R, C) kernel — and bias the threshold by orders of magnitude under
+    per-channel scale structure) and the STEP is folded into the key so
+    the sampled positions rotate every step: with a frozen sample,
+    entries outside it never influence the estimate, a persistent bias
+    the paper's per-step resampling avoids."""
     n = flat.size
     if n <= _SAMPLE_CAP:
         k = max(1, int(round(n * keep_frac)))
         return jax.lax.top_k(jnp.abs(flat), k)[0][-1]
-    idx = jax.random.randint(jax.random.PRNGKey(n % (2**31 - 1)),
-                             (_SAMPLE_CAP,), 0, n)
+    key = jax.random.fold_in(jax.random.PRNGKey(n % (2**31 - 1)), step)
+    idx = jax.random.randint(key, (_SAMPLE_CAP,), 0, n)
     sample = jnp.abs(flat[idx])
     k = max(1, int(round(sample.size * keep_frac)))
     return jax.lax.top_k(sample, k)[0][-1]
@@ -92,13 +96,13 @@ def dgc(sparsity: float = 0.99, momentum: float = 0.9,
                         momentum=zeros,
                         residual=jax.tree.map(jnp.zeros_like, params))
 
-    def _compress_leaf(u, v):
+    def _compress_leaf(u, v, step):
         """u: momentum buffer, v: accumulated velocity. Returns
         (sent, new_u, new_v) for one leaf."""
         n = v.size
         if n < 64 or sparsity == 0.0:
             return v, u, jnp.zeros_like(v)
-        thresh = _topk_threshold(v.reshape(-1), 1.0 - sparsity)
+        thresh = _topk_threshold(v.reshape(-1), 1.0 - sparsity, step)
         mask = (jnp.abs(v) >= thresh).astype(v.dtype)
         sent = v * mask
         keep = 1.0 - mask
@@ -114,7 +118,8 @@ def dgc(sparsity: float = 0.99, momentum: float = 0.9,
         u_new = jax.tree.map(corrected, state.momentum, updates)
         v_new = jax.tree.map(jnp.add, state.residual, u_new)
 
-        compressed = jax.tree.map(_compress_leaf, u_new, v_new)
+        compressed = jax.tree.map(lambda u, v: _compress_leaf(u, v, step),
+                                  u_new, v_new)
         sent = jax.tree.map(lambda t: t[0], compressed,
                             is_leaf=lambda t: isinstance(t, tuple))
         u_kept = jax.tree.map(lambda t: t[1], compressed,

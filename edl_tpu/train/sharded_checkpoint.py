@@ -56,15 +56,18 @@ def _slices_to_offset_shape(index: tuple, shape: tuple[int, ...]
     return tuple(offset), tuple(size)
 
 
-def save_sharded(directory: str, state: Any) -> None:
+def save_sharded(directory: str, state: Any) -> list[str]:
     """Write this process's unique shards of `state` into `directory`.
 
     Every process of the world must call this with the same state; chunks
     are deduplicated so each array region is written exactly once
-    world-wide (the writer is the shard with replica_id == 0).
+    world-wide (the writer is the shard with replica_id == 0). Returns
+    the basenames of the files THIS process wrote (its chunks + its index
+    file) — what a non-shared-FS mirror must upload from this host.
     """
     os.makedirs(directory, exist_ok=True)
     leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    written: list[str] = []
     table = []
     for i, (path, leaf) in enumerate(leaves):
         key = _leaf_key(path)
@@ -79,6 +82,7 @@ def save_sharded(directory: str, state: Any) -> None:
                 fname = _chunk_name(i, offset)
                 np.save(os.path.join(directory, fname),
                         np.asarray(shard.data))
+                written.append(fname)
                 chunks.append({"offset": list(offset), "shape": list(size),
                                "file": fname})
         else:  # host scalar / numpy leaf — process 0 owns it whole
@@ -89,13 +93,16 @@ def save_sharded(directory: str, state: Any) -> None:
                 offset = tuple(0 for _ in shape)
                 fname = _chunk_name(i, offset)
                 np.save(os.path.join(directory, fname), arr)
+                written.append(fname)
                 chunks.append({"offset": list(offset),
                                "shape": list(arr.shape), "file": fname})
         table.append({"key": key, "shape": list(shape), "dtype": dtype,
                       "chunks": chunks})
-    with open(os.path.join(directory,
-                           f"index.{jax.process_index()}.json"), "w") as f:
+    index_name = f"index.{jax.process_index()}.json"
+    with open(os.path.join(directory, index_name), "w") as f:
         json.dump({"leaves": table}, f)
+    written.append(index_name)
+    return written
 
 
 def _merged_index(directory: str) -> dict[str, dict]:

@@ -131,7 +131,19 @@ class LocalFS(FileSystem):
             finally:
                 shutil.rmtree(tmp, ignore_errors=True)
         else:
-            shutil.copy2(local, dst)
+            # temp + rename for single files too: a copy2 interrupted
+            # mid-write (ENOSPC, kill) must not leave a truncated dst
+            # that presence-based checks then trust (e.g. the sharded-
+            # mirror completeness gate keying on index.{r}.json)
+            fd, tmp = tempfile.mkstemp(prefix=".edl-up-",
+                                       dir=os.path.dirname(dst) or ".")
+            os.close(fd)
+            try:
+                shutil.copy2(local, tmp)
+                os.rename(tmp, dst)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
 
     def download(self, uri: str, local: str) -> None:
         src = self._path(uri)
@@ -260,8 +272,35 @@ def mirror_checkpoint(local_dir: str, version: int, remote_root: str,
     fs = resolve(remote_root)
     name = f"ckpt-{version}"
     fs.upload(os.path.join(local_dir, name), join_uri(remote_root, name))
-    fs.write_text(join_uri(remote_root, _LATEST), str(version))
+    finalize_mirror(remote_root, version, keep=keep)
     log.info("mirrored %s -> %s", name, remote_root)
+
+
+def mirror_checkpoint_files(version_dir: str, version: int,
+                            remote_root: str, files: Sequence[str]) -> None:
+    """Upload the named files of a (possibly still pending) version dir
+    into the remote `ckpt-{version}` — WITHOUT touching LATEST.
+
+    The sharded-save mirror path for clusters where the local checkpoint
+    dir is NOT shared: every process pushes its own chunks + index file
+    this way (from its pending dir), and only after all of them are up
+    does rank 0 upload meta.json and flip the marker (`finalize_mirror`)
+    — marker-last across the whole world, so a cold pod never reassembles
+    from an index whose chunks are missing. Uploading only rank 0's local
+    dir would mirror only rank 0's chunks.
+    """
+    fs = resolve(remote_root)
+    name = f"ckpt-{version}"
+    for fname in files:
+        fs.upload(os.path.join(version_dir, fname),
+                  join_uri(remote_root, name, fname))
+
+
+def finalize_mirror(remote_root: str, version: int, *,
+                    keep: int | None = None) -> None:
+    """Flip LATEST to `version` (all files must already be up) + GC."""
+    fs = resolve(remote_root)
+    fs.write_text(join_uri(remote_root, _LATEST), str(version))
     if keep is not None:
         versions = remote_versions(remote_root)
         for v in versions[: max(0, len(versions) - keep)]:
@@ -329,5 +368,18 @@ def fetch_file(uri: str, cache_dir: str | None = None) -> str:
     os.makedirs(cache_dir, exist_ok=True)
     dst = os.path.join(cache_dir, rest.replace("/", "_"))
     if not os.path.exists(dst):
-        resolve(uri).download(uri, dst)
+        # download-to-temp + rename (same contract as
+        # fetch_latest_checkpoint): a CLI killed mid-transfer must not
+        # leave a partial file that existence-caching then serves forever
+        tmp = tempfile.mkdtemp(prefix=".tmp-fetch-", dir=cache_dir)
+        try:
+            staged = os.path.join(tmp, "f")
+            resolve(uri).download(uri, staged)
+            try:
+                os.rename(staged, dst)
+            except OSError:
+                if not os.path.exists(dst):  # concurrent-fetch race: fine
+                    raise
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
     return dst

@@ -133,6 +133,33 @@ class TestDgc:
         kept2 = int(jnp.sum(out2["w"] != 0)) / n
         assert 0.002 < kept2 < 0.05, kept2
 
+    def test_sample_rotates_across_steps(self):
+        """The threshold sample must use different indices each step —
+        a frozen sample never lets out-of-sample entries influence the
+        estimate (ADVICE r3 / DGC paper's per-step resampling)."""
+        from edl_tpu.train.dgc import _SAMPLE_CAP, _topk_threshold
+        flat = jax.random.normal(jax.random.PRNGKey(0), (_SAMPLE_CAP * 8,))
+        t1 = _topk_threshold(flat, 0.01, jnp.int32(1))
+        t2 = _topk_threshold(flat, 0.01, jnp.int32(2))
+        t1b = _topk_threshold(flat, 0.01, jnp.int32(1))
+        assert float(t1) == float(t1b)        # deterministic per step
+        assert float(t1) != float(t2)         # but rotates across steps
+
+    def test_rotating_sample_tracks_true_quantile(self):
+        """On a structured tensor where any single sample is biased, the
+        LONG-RUN mean threshold must track the exact 99th percentile."""
+        from edl_tpu.train.dgc import _SAMPLE_CAP, _topk_threshold
+        n = _SAMPLE_CAP * 16
+        # heavy-tailed + structured: planted large entries in one block
+        flat = jax.random.normal(jax.random.PRNGKey(3), (n,))
+        flat = flat.at[:n // 64].multiply(10.0)
+        exact = float(jnp.sort(jnp.abs(flat))[int(n * 0.99)])
+        ts = [float(_topk_threshold(flat, 0.01, jnp.int32(s)))
+              for s in range(32)]
+        mean_t = float(np.mean(ts))
+        assert abs(mean_t - exact) / exact < 0.15, (mean_t, exact)
+        assert np.std(ts) > 0  # genuinely resampling
+
     def test_rampup_is_momentum_corrected(self):
         """Ramp-up must emit heavyball-momentum updates (buffers carry),
         not raw gradients — matching the reference's DGCMomentum."""

@@ -51,6 +51,19 @@ class TestFileSource:
             np.testing.assert_array_equal(got["x"], x[np.array(idx)])
         assert len(fs._cache) <= 1 + 1  # bounded
 
+    def test_empty_index_returns_empty_arrays(self, tmp_path):
+        """A zero-length index (a remote client's empty batch request)
+        yields empty arrays with the right trailing shapes/dtypes, not
+        an IndexError (ADVICE r3)."""
+        files, _, _ = _write_shards(tmp_path, [4, 4])
+        src = FileSource(files)
+        out = src.batch(np.array([], dtype=np.int64))
+        full = src.batch(np.arange(2))
+        assert set(out) == set(full)
+        for k in out:
+            assert out[k].shape == (0,) + full[k].shape[1:]
+            assert out[k].dtype == full[k].dtype
+
     def test_empty_file_list_rejected(self):
         with pytest.raises(EdlDataError):
             FileSource([])

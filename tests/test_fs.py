@@ -135,6 +135,39 @@ class TestUriPlumbing:
         finally:
             fslib._SCHEMES.pop("fake")
 
+    def test_fetch_file_partial_download_not_cached(self, tmp_path):
+        """A download killed mid-transfer must not leave a partial file
+        the existence-cache serves forever (the CommandFS failure mode:
+        gsutil creates dst, then dies)."""
+        src = tmp_path / "params.bin"
+        src.write_text("all-the-weights")
+        calls = {"n": 0}
+
+        class FlakyFS(fslib.LocalFS):
+            @staticmethod
+            def _path(uri):
+                return uri.split("://", 1)[1] if "://" in uri else uri
+
+            def download(self, uri, local):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    with open(local, "w") as f:
+                        f.write("all-th")  # truncated
+                    raise fslib.EdlFsError("killed mid-transfer")
+                super().download(uri, local)
+
+        fslib.register_scheme("flaky", FlakyFS)
+        try:
+            cache = tmp_path / "cache"
+            with pytest.raises(fslib.EdlFsError):
+                fslib.fetch_file(f"flaky://{src}", str(cache))
+            # retry must re-download (no partial file poisoning the cache)
+            out = fslib.fetch_file(f"flaky://{src}", str(cache))
+            assert open(out).read() == "all-the-weights"
+            assert calls["n"] == 2
+        finally:
+            fslib._SCHEMES.pop("flaky")
+
 
 class TestCheckpointMirror:
     def _state(self, value):
@@ -225,6 +258,41 @@ class TestCheckpointMirror:
         mgr = CheckpointManager(str(tmp_path / "only"), process_index=0)
         mgr.save(self._state(1.0), TrainStatus(epoch=0, step=0, world_size=1))
         assert mgr.restore(self._state(0.0)) is not None
+
+    def test_sharded_mirror_incomplete_does_not_flip_latest(
+            self, tmp_path, monkeypatch):
+        """If a rank's chunk/index upload fails, rank 0's completeness
+        gate must NOT flip LATEST to the holey version (a cold pod would
+        reassemble from a missing index)."""
+        from edl_tpu.parallel.mesh import MeshSpec, make_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        remote = str(tmp_path / "remote")
+        mesh = make_mesh(MeshSpec({"dp": -1}))
+        sharding = NamedSharding(mesh, P())
+        arr = jax.device_put(np.arange(8, dtype=np.float32), sharding)
+        mgr = CheckpointManager(str(tmp_path / "l"), sharded=True,
+                                remote=remote)
+        real = fslib.mirror_checkpoint_files
+        calls = {"n": 0}
+
+        def flaky(version_dir, version, remote_root, files):
+            calls["n"] += 1
+            if calls["n"] == 1:  # the per-rank chunks+index upload
+                raise OSError("disk full mid-upload")  # raw, not EdlFsError
+            real(version_dir, version, remote_root, files)
+
+        monkeypatch.setattr(fslib, "mirror_checkpoint_files", flaky)
+        v = mgr.save({"w": arr}, TrainStatus(epoch=0, step=0, world_size=1))
+        assert v == 0  # local save sealed
+        assert fslib.remote_latest_version(remote) is None  # no flip
+        # next save (uploads fine) flips LATEST and the remote dir was
+        # cleaned of the stale partial before re-upload
+        mgr.save({"w": arr}, TrainStatus(epoch=0, step=1, world_size=1))
+        assert fslib.remote_latest_version(remote) == 1
+        cold = CheckpointManager(str(tmp_path / "cold"), remote=remote)
+        target = jax.device_put(np.zeros(8, np.float32), sharding)
+        out = cold.restore({"w": target})
+        assert out is not None and out[1].step == 1
 
     def test_sharded_save_mirrors(self, tmp_path):
         # single-process sharded save still goes through _mirror
