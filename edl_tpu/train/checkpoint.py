@@ -47,6 +47,22 @@ _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
 _INDEX_FILE_RE = re.compile(r"^index\.(\d+)\.json$")
 
 
+def _local_sharded_complete(path: str) -> bool:
+    """Does this sealed sharded dir hold every rank's index of the world
+    that SAVED it (meta.json's world.process_count)? False on a pod-local
+    dir that only ever received its own rank's files."""
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return False
+    world = (meta.get("world") or {}).get("process_count")
+    if not world:
+        return True  # pre-world-record format: nothing to check against
+    names = set(os.listdir(path))
+    return all(f"index.{r}.json" in names for r in range(world))
+
+
 class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
                  process_index: int | None = None, sharded: bool = False,
@@ -142,13 +158,44 @@ class CheckpointManager:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices(tag)
 
+    def _broadcast_int(self, value: int) -> int:
+        """Rank 0's value, world-wide (identity in a 1-process world)."""
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+            return int(multihost_utils.broadcast_one_to_all(
+                np.int32(value)))
+        return value
+
     def _save_sharded(self, state: Any, status: TrainStatus) -> int | None:
-        # All processes agree on the version: the barrier orders this
-        # listing after every process finished (and rank 0 sealed) any
-        # previous save.
+        # All processes must agree on the version. A per-process
+        # latest_version() listing diverges when local dirs are NOT
+        # shared (only rank 0 ever seals locally, so other pods would
+        # recompute version 0 forever and overwrite the published remote
+        # ckpt-0 with later-step chunks) — so rank 0 decides, folding in
+        # the remote mirror's LATEST (its own local dir may be cold
+        # after an in-place restart), and broadcasts.
         self._sync("edl_ckpt_begin")
         latest = self.latest_version()
-        version = 0 if latest is None else latest + 1
+        remote_read_ok = True
+        if self.remote is not None and self.process_index == 0:
+            from edl_tpu.utils import fs
+            try:
+                remote_latest = fs.remote_latest_version(self.remote)
+            except Exception as exc:  # noqa: BLE001 — mirror-only
+                # With the remote view unknown, a cold-restarted rank 0
+                # could reuse a PUBLISHED version number — and the
+                # pre-upload clean would then delete the published
+                # checkpoint. Skip this save's mirror entirely (via the
+                # clean_ok broadcast); the next successful read resumes
+                # numbering above the remote's.
+                log.warning("remote LATEST unreadable (%s) — skipping "
+                            "this save's mirror", exc)
+                remote_latest, remote_read_ok = None, False
+            if remote_latest is not None:
+                latest = remote_latest if latest is None else max(
+                    latest, remote_latest)
+        version = self._broadcast_int(0 if latest is None else latest + 1)
         os.makedirs(self.directory, exist_ok=True)
         tmp = os.path.join(self.directory, f".tmp-ckpt-{version}")
         # A crashed earlier save may have left stale chunks/indexes under
@@ -157,6 +204,17 @@ class CheckpointManager:
         # clears the dir before anyone writes.
         if self.process_index == 0:
             shutil.rmtree(tmp, ignore_errors=True)
+        # Every rank clears its OWN stale pending dirs from earlier
+        # versions: on non-shared dirs only rank 0 ever renames or runs
+        # _gc, so without this each save would leak a full shard copy
+        # per pod (at most the CURRENT pending dir remains between
+        # saves). Safe on shared dirs too — anything below the agreed
+        # version is an orphan by the begin barrier.
+        for n in os.listdir(self.directory):
+            if (n.startswith(".tmp-ckpt-")
+                    and n != os.path.basename(tmp)):
+                shutil.rmtree(os.path.join(self.directory, n),
+                              ignore_errors=True)
         self._sync("edl_ckpt_clean")
         # A process that fails mid-write must still reach the barrier
         # (otherwise the healthy ranks hang in it until the coordination
@@ -177,15 +235,25 @@ class CheckpointManager:
         self._sync("edl_ckpt_chunks")
         poisoned = [n for n in (os.listdir(tmp) if os.path.isdir(tmp) else [])
                     if n.startswith("save_failed.")]
-        if failure is not None or poisoned:
+        ok = failure is None and not poisoned
+        if self.remote is not None:
+            # The mirror block runs its barriers on EVERY rank — healthy
+            # or not — before any raise below: on non-shared dirs a
+            # healthy rank cannot see a failed rank's poison marker, so
+            # raising first would strand the healthy world in the mirror
+            # barriers until the coordination timeout. A rank that
+            # failed (or saw poison) participates without uploading.
+            mirror_ok = self._mirror_sharded_upload(
+                tmp, version, my_files, ok=ok and remote_read_ok)
+        else:
+            mirror_ok = False
+        if not ok:
             if self.process_index == 0:
                 shutil.rmtree(tmp, ignore_errors=True)
             if failure is not None:
                 raise failure
             raise RuntimeError(
                 f"sharded save aborted: {poisoned} failed")
-        if self.remote is not None:
-            self._mirror_sharded_upload(tmp, version, my_files)
         try:
             if self.process_index == 0:
                 meta = {"version": version, "status": status.to_dict(),
@@ -202,40 +270,62 @@ class CheckpointManager:
             return None
         log.info("saved sharded checkpoint %s (epoch=%d step=%d)",
                  self._path(version), status.epoch, status.step)
-        if self.remote is not None:
+        if self.remote is not None and mirror_ok:
+            # mirror_ok=False means nobody uploaded (remote clean or
+            # LATEST read failed) — finalizing would gate against STALE
+            # files from a crashed earlier attempt at this version,
+            # which (same world shape) could pass the exact-set check
+            # and flip LATEST to old-step data.
             self._mirror_sharded_finalize(version)
         self._gc()
         return version
 
     def _mirror_sharded_upload(self, tmp: str, version: int,
-                               my_files: list[str]) -> None:
+                               my_files: list[str], *, ok: bool) -> bool:
         """EVERY process uploads its own chunks + index from its pending
         dir (local dirs need not be shared across pods); rank 0 uploads
         meta.json + flips LATEST only in `_mirror_sharded_finalize`, so
-        the marker is last world-wide."""
+        the marker is last world-wide. `ok=False` ranks (their own write
+        failed, they saw a poison marker, or rank 0 could not read the
+        remote LATEST) run the barriers without uploading. Returns
+        whether the world proceeded with uploads (rank 0's clean
+        succeeded) — the caller gates `_mirror_sharded_finalize` on it,
+        since finalizing after a failed clean would gate against STALE
+        files from a crashed earlier attempt at this version."""
         from edl_tpu.utils import fs
-        if self.process_index == 0:
+        clean_ok = 1 if ok else 0  # rank 0's value wins via broadcast
+        if self.process_index == 0 and ok:
             # A crashed earlier save at this version (possibly a
             # different world shape) may have left stale chunks/indexes
             # in the remote dir; merging them in would corrupt the
             # restore — same hazard the local tmp-clean guards against.
+            # If the clean FAILS, a stale index.{r}.json could survive a
+            # rank's failed re-upload and defeat the finalize gate's
+            # exact-set check (old-attempt chunks merged into restores),
+            # so the whole world skips this version's mirror instead.
             try:
                 fs.resolve(self.remote).delete(
                     fs.join_uri(self.remote, f"ckpt-{version}"))
             except Exception as exc:  # noqa: BLE001 — mirror-only
-                log.warning("remote clean of ckpt-%d failed: %s",
-                            version, exc)
-        self._sync("edl_ckpt_mirror_clean")
-        try:
-            fs.mirror_checkpoint_files(tmp, version, self.remote, my_files)
-        except Exception as exc:  # noqa: BLE001 — any transfer error
-            # Swallow so this rank still reaches the barrier (a raw
-            # OSError from LocalFS would strand the world in _sync). The
-            # missing index.{rank}.json is what the finalize gate keys
-            # on, so LATEST never flips to this incomplete version.
-            log.warning("sharded mirror of ckpt-%d (rank %d) failed: %s",
-                        version, self.process_index, exc)
+                log.warning("remote clean of ckpt-%d failed — skipping "
+                            "this version's mirror: %s", version, exc)
+                clean_ok = 0
+        clean_ok = self._broadcast_int(clean_ok)
+        if ok and clean_ok:
+            try:
+                fs.mirror_checkpoint_files(tmp, version, self.remote,
+                                           my_files)
+            except Exception as exc:  # noqa: BLE001 — any transfer error
+                # Swallow so this rank still reaches the barrier (a raw
+                # OSError from LocalFS would strand the world in _sync).
+                # The missing index.{rank}.json is what the finalize
+                # gate keys on, so LATEST never flips to this
+                # incomplete version.
+                log.warning(
+                    "sharded mirror of ckpt-%d (rank %d) failed: %s",
+                    version, self.process_index, exc)
         self._sync("edl_ckpt_mirror")
+        return bool(clean_ok)
 
     def _mirror_sharded_finalize(self, version: int) -> None:
         """Rank 0 only. NOT `_mirror`: a whole-dir upload would replace
@@ -317,6 +407,33 @@ class CheckpointManager:
             fs.fetch_latest_checkpoint(self.remote, self.directory,
                                        version=version)
         path = self._path(version)
+        if (self.remote is not None and os.path.isdir(path)
+                and sc.is_sharded_dir(path)
+                and not _local_sharded_complete(path)):
+            # Non-shared dirs: a pod's locally sealed sharded version
+            # holds only its OWN chunks + index (rank 0's, after an
+            # in-place restart). Reassembling from it would miss every
+            # region other ranks owned — refetch the complete mirrored
+            # copy instead of trusting local presence. Verify the mirror
+            # actually HAS a complete copy before deleting the local dir
+            # (it is this pod's only copy of its own chunks).
+            from edl_tpu.utils import fs
+            try:
+                remote_complete = fs.remote_version_complete(self.remote,
+                                                             version)
+            except Exception:  # noqa: BLE001 — mirror-only
+                remote_complete = False
+            if remote_complete:
+                log.info("local %s incomplete for its saved world — "
+                         "refetching from mirror", path)
+                shutil.rmtree(path, ignore_errors=True)
+                fs.fetch_latest_checkpoint(self.remote, self.directory,
+                                           version=version)
+            else:
+                log.warning(
+                    "local %s incomplete and mirror has no complete "
+                    "copy — restoring from local (may fail coverage)",
+                    path)
         if sc.is_sharded_dir(path):
             state = sc.restore_sharded(path, target)
         else:

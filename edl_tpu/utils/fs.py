@@ -296,15 +296,35 @@ def mirror_checkpoint_files(version_dir: str, version: int,
                   join_uri(remote_root, name, fname))
 
 
+def remote_version_complete(remote_root: str, version: int) -> bool:
+    """A remote version dir counts as complete once it holds meta.json —
+    the last file both mirror paths upload (the sharded path uploads it
+    in finalize after the index gate; the replicated path uploads the
+    sealed dir wholesale). A dir abandoned by a failed mirror lacks it."""
+    fs = resolve(remote_root)
+    return fs.exists(join_uri(remote_root, f"ckpt-{version}", "meta.json"))
+
+
 def finalize_mirror(remote_root: str, version: int, *,
                     keep: int | None = None) -> None:
-    """Flip LATEST to `version` (all files must already be up) + GC."""
+    """Flip LATEST to `version` (all files must already be up) + GC.
+
+    GC retention counts only COMPLETE versions — a partial dir left by a
+    failed earlier mirror must not occupy a retention slot (that would
+    delete an older complete version early); partials older than the
+    newest complete `keep` are deleted outright as garbage.
+    """
     fs = resolve(remote_root)
     fs.write_text(join_uri(remote_root, _LATEST), str(version))
     if keep is not None:
         versions = remote_versions(remote_root)
-        for v in versions[: max(0, len(versions) - keep)]:
-            fs.delete(join_uri(remote_root, f"ckpt-{v}"))
+        complete = [v for v in versions
+                    if remote_version_complete(remote_root, v)]
+        cutoff = complete[-keep] if len(complete) >= keep else None
+        if cutoff is not None:
+            for v in versions:
+                if v < cutoff:
+                    fs.delete(join_uri(remote_root, f"ckpt-{v}"))
 
 
 def remote_versions(remote_root: str) -> list[int]:
@@ -335,7 +355,11 @@ def fetch_latest_checkpoint(remote_root: str, local_dir: str,
         if not fs.exists(marker):
             return None
         version = int(fs.read_text(marker).strip())
-    elif version not in remote_versions(remote_root):
+    elif (version not in remote_versions(remote_root)
+          or not remote_version_complete(remote_root, version)):
+        # an explicitly requested version must also be COMPLETE — a
+        # partial dir from a failed mirror would download but then
+        # crash the restore on its missing meta.json
         return None
     name = f"ckpt-{version}"
     dst = os.path.join(local_dir, name)

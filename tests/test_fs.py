@@ -193,6 +193,9 @@ class TestCheckpointMirror:
         local, remote = str(tmp_path / "l"), str(tmp_path / "r")
         for v in range(3):
             os.makedirs(os.path.join(local, f"ckpt-{v}"))
+            with open(os.path.join(local, f"ckpt-{v}", "meta.json"),
+                      "w") as f:
+                json.dump({"version": v}, f)
             fslib.mirror_checkpoint(local, v, remote, keep=2)
         assert fslib.remote_versions(remote) == [1, 2]
 
@@ -293,6 +296,90 @@ class TestCheckpointMirror:
         target = jax.device_put(np.zeros(8, np.float32), sharding)
         out = cold.restore({"w": target})
         assert out is not None and out[1].step == 1
+
+    def test_gc_ignores_partial_versions(self, tmp_path):
+        """A partial remote dir (failed mirror, no meta.json) must not
+        occupy a retention slot — and gets deleted once it falls below
+        the newest-complete cutoff."""
+        remote = str(tmp_path / "r")
+        for v in (0, 2, 3):
+            os.makedirs(os.path.join(remote, f"ckpt-{v}"))
+            with open(os.path.join(remote, f"ckpt-{v}", "meta.json"),
+                      "w") as f:
+                json.dump({"version": v}, f)
+        os.makedirs(os.path.join(remote, "ckpt-1"))  # partial: no meta
+        with open(os.path.join(remote, "ckpt-1", "index.0.json"), "w") as f:
+            f.write("{}")
+        fslib.finalize_mirror(remote, 3, keep=2)
+        # complete 2,3 kept; complete 0 GC'd; partial 1 GC'd as garbage
+        assert fslib.remote_versions(remote) == [2, 3]
+
+    def test_fetch_explicit_partial_version_refused(self, tmp_path):
+        remote = str(tmp_path / "r")
+        os.makedirs(os.path.join(remote, "ckpt-0"))  # no meta.json
+        assert fslib.fetch_latest_checkpoint(
+            remote, str(tmp_path / "d"), version=0) is None
+
+    def test_restore_refetches_incomplete_local_sharded(self, tmp_path):
+        """An in-place-restarted pod's local sharded ckpt holds only its
+        OWN chunks/index; restore must refetch the complete mirror copy
+        instead of reassembling a holey state."""
+        from edl_tpu.parallel.mesh import MeshSpec, make_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        remote = str(tmp_path / "remote")
+        mesh = make_mesh(MeshSpec({"dp": -1}))
+        sharding = NamedSharding(mesh, P())
+        arr = jax.device_put(np.full(8, 7.0, np.float32), sharding)
+        writer = CheckpointManager(str(tmp_path / "w"), sharded=True,
+                                   remote=remote)
+        writer.save({"w": arr}, TrainStatus(epoch=1, step=5, world_size=2))
+        # simulate rank 0's pod-local view of a 2-process world: its
+        # sealed dir claims world.process_count=2 but only has index.0
+        local = str(tmp_path / "pod0")
+        ck = os.path.join(local, "ckpt-0")
+        os.makedirs(ck)
+        with open(os.path.join(ck, "meta.json"), "w") as f:
+            json.dump({"version": 0, "format": "sharded",
+                       "status": {"epoch": 0, "step": 0, "world_size": 2},
+                       "world": {"process_count": 2, "device_count": 2}},
+                      f)
+        with open(os.path.join(ck, "index.0.json"), "w") as f:
+            json.dump({"leaves": []}, f)
+        mgr = CheckpointManager(local, remote=remote)
+        target = jax.device_put(np.zeros(8, np.float32), sharding)
+        out = mgr.restore({"w": target})
+        assert out is not None
+        np.testing.assert_array_equal(np.asarray(out[0]["w"]),
+                                      np.full(8, 7.0, np.float32))
+        assert out[1].step == 5  # the mirror's status, not the stub's
+
+    def test_remote_clean_failure_skips_finalize(self, tmp_path,
+                                                 monkeypatch):
+        """If rank 0 cannot clear a stale remote version dir, nothing is
+        uploaded and LATEST must not flip (stale same-name indexes could
+        otherwise pass the exact-set gate)."""
+        from edl_tpu.parallel.mesh import MeshSpec, make_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        remote = str(tmp_path / "remote")
+        # plant a stale complete-LOOKING remote ckpt-0 from a "crashed
+        # earlier attempt" (index present, old data)
+        os.makedirs(os.path.join(remote, "ckpt-0"))
+        with open(os.path.join(remote, "ckpt-0", "index.0.json"),
+                  "w") as f:
+            json.dump({"leaves": []}, f)
+        mesh = make_mesh(MeshSpec({"dp": -1}))
+        sharding = NamedSharding(mesh, P())
+        arr = jax.device_put(np.arange(8, dtype=np.float32), sharding)
+        mgr = CheckpointManager(str(tmp_path / "l"), sharded=True,
+                                remote=remote)
+
+        def no_delete(self, uri):
+            raise OSError("permission denied")
+
+        monkeypatch.setattr(fslib.LocalFS, "delete", no_delete)
+        v = mgr.save({"w": arr}, TrainStatus(epoch=0, step=0, world_size=1))
+        assert v == 0  # local save sealed regardless
+        assert fslib.remote_latest_version(remote) is None  # no flip
 
     def test_sharded_save_mirrors(self, tmp_path):
         # single-process sharded save still goes through _mirror
