@@ -1,0 +1,225 @@
+"""Host-side JPEG decode + augmentation input plane.
+
+Capability of the reference's cv2 reader stack — the file-list reader
+with a decode thread pool (example/collective/resnet50/utils/
+reader_cv2.py:27-105, `xmap_readers(image_mapper, _reader, threads,
+buf_size)`) and its transform set (example/collective/resnet50/utils/
+img_tool.py:34-69 random-resized-crop with scale/ratio sampling,
+:128-131 horizontal flip p=0.5, :77-103 resize-short + center-crop for
+eval) — re-designed for this stack's deterministic elastic contract:
+
+- **uint8 NHWC RGB out, normalize ON DEVICE.** The reference converts
+  to float32 and normalizes per channel on the host
+  (img_tool.py:133-140); here the host ships 1 byte per channel and the
+  jitted step does mean/std math on chip (the DALI recipe — shipping
+  float32 pixels quadruples H2D bytes, and H2D is the scarce resource
+  on a TPU VM).
+- **Determinism under a thread pool.** The reference's xmap runs
+  `order=False` with a shared `random` module — worker scheduling
+  changes the stream, so an elastic restart cannot replay it. Here
+  every sample's augmentation RNG seed is PRE-ASSIGNED from the
+  loader's per-(epoch, rank) generator before the pool touches the
+  batch, so any thread interleaving produces bit-identical batches
+  (the D-invariant that makes the <1%-acc-over-resizes clause
+  testable).
+- Transforms are per-SAMPLE callables `(sample_dict, rng) -> dict`
+  (images arrive in variable sizes; batch-level transforms only exist
+  after collation). `DataLoader(sample_transforms=...)` runs them under
+  its decode pool — see data/pipeline.py.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Sequence
+
+import numpy as np
+
+from edl_tpu.utils.exceptions import EdlDataError
+
+try:  # cv2 is the decode engine (same as the reference's reader)
+    import cv2
+
+    cv2.setNumThreads(0)  # the loader's pool owns parallelism, not cv2
+except ImportError:  # pragma: no cover - cv2 is baked into the image
+    cv2 = None
+
+
+def _require_cv2() -> None:
+    if cv2 is None:
+        raise EdlDataError("cv2 is required for the JPEG input plane")
+
+
+def decode_jpeg(buf: bytes | np.ndarray) -> np.ndarray:
+    """JPEG/PNG bytes -> RGB uint8 HWC (reference decodes BGR via
+    cv2.imread then flips to RGB at normalize time, img_tool.py:133)."""
+    _require_cv2()
+    arr = np.frombuffer(buf, np.uint8) if isinstance(buf, (bytes, bytearray)) \
+        else np.asarray(buf, np.uint8)
+    img = cv2.imdecode(arr, cv2.IMREAD_COLOR)
+    if img is None:
+        raise EdlDataError("cv2 could not decode image bytes")
+    return img[:, :, ::-1]  # BGR -> RGB
+
+
+def encode_jpeg(img: np.ndarray, quality: int = 90) -> bytes:
+    """RGB uint8 HWC -> JPEG bytes (synthetic-dataset / test helper)."""
+    _require_cv2()
+    ok, buf = cv2.imencode(".jpg", np.asarray(img)[:, :, ::-1],
+                           [int(cv2.IMWRITE_JPEG_QUALITY), quality])
+    if not ok:
+        raise EdlDataError("cv2 could not encode image")
+    return bytes(buf)
+
+
+def random_resized_crop(img: np.ndarray, rng: np.random.Generator,
+                        size: int, scale: tuple[float, float] = (0.08, 1.0),
+                        ratio: tuple[float, float] = (3 / 4, 4 / 3)
+                        ) -> np.ndarray:
+    """The Inception-style crop of the reference (img_tool.py:34-69):
+    sample aspect = sqrt(U(ratio)), bound the area scale so the crop
+    fits, take a uniform window, resize to (size, size)."""
+    _require_cv2()
+    h, w = img.shape[:2]
+    aspect = math.sqrt(rng.uniform(*ratio))
+    cw, ch = aspect, 1.0 / aspect
+    bound = min((w / h) / (cw * cw), (h / w) / (ch * ch))
+    scale_max = min(scale[1], bound)
+    scale_min = min(scale[0], bound)
+    target_area = h * w * rng.uniform(scale_min, scale_max)
+    target = math.sqrt(target_area)
+    # int() truncation keeps the window inside the image (the bound
+    # guarantees the exact-real window fits); clamp for 1-pixel edges
+    cw = min(max(1, int(target * cw)), w)
+    ch = min(max(1, int(target * ch)), h)
+    i = rng.integers(0, h - ch + 1)
+    j = rng.integers(0, w - cw + 1)
+    return cv2.resize(img[i:i + ch, j:j + cw], (size, size),
+                      interpolation=cv2.INTER_LINEAR)
+
+
+def random_flip_lr_sample(img: np.ndarray, rng: np.random.Generator
+                          ) -> np.ndarray:
+    """Horizontal flip with p=0.5 (img_tool.py:128-129)."""
+    return img[:, ::-1] if rng.random() < 0.5 else img
+
+
+def resize_short(img: np.ndarray, target: int) -> np.ndarray:
+    """Scale so the SHORT side equals target (img_tool.py:77-86)."""
+    _require_cv2()
+    h, w = img.shape[:2]
+    percent = target / min(h, w)
+    return cv2.resize(img, (int(round(w * percent)),
+                            int(round(h * percent))),
+                      interpolation=cv2.INTER_LINEAR)
+
+
+def center_crop(img: np.ndarray, size: int) -> np.ndarray:
+    """Central (size, size) window (img_tool.py:89-103 center=True)."""
+    h, w = img.shape[:2]
+    i = (h - size) // 2
+    j = (w - size) // 2
+    return img[i:i + size, j:j + size]
+
+
+def train_image_transform(size: int = 224,
+                          scale: tuple[float, float] = (0.08, 1.0),
+                          ratio: tuple[float, float] = (3 / 4, 4 / 3),
+                          key: str = "jpeg", out: str = "image"):
+    """Per-sample train path: decode -> random-resized-crop -> flip.
+
+    Returns a `(sample, rng) -> sample` callable for
+    `DataLoader(sample_transforms=...)`. Output is uint8 (size, size, 3)
+    RGB under `out`; the raw bytes key is dropped."""
+
+    def transform(sample: dict, rng: np.random.Generator) -> dict:
+        img = decode_jpeg(sample[key])
+        img = random_resized_crop(img, rng, size, scale, ratio)
+        img = random_flip_lr_sample(img, rng)
+        rest = {k: v for k, v in sample.items() if k != key}
+        return {**rest, out: np.ascontiguousarray(img)}
+
+    return transform
+
+
+def eval_image_transform(size: int = 224, short: int = 256,
+                         key: str = "jpeg", out: str = "image"):
+    """Per-sample eval path: decode -> resize-short -> center-crop
+    (img_tool.py:134-137, resize_short_size=256 for crop 224)."""
+
+    def transform(sample: dict, rng: np.random.Generator) -> dict:
+        del rng  # eval is augmentation-free
+        img = decode_jpeg(sample[key])
+        img = center_crop(resize_short(img, short), size)
+        rest = {k: v for k, v in sample.items() if k != key}
+        return {**rest, out: np.ascontiguousarray(img)}
+
+    return transform
+
+
+class JpegFileListSource:
+    """Random-access source over a `path label` file list of JPEGs.
+
+    The reference's file-list contract (reader_cv2.py:39-88: one
+    `<relpath> <int label>` pair per line, paths relative to a data
+    root). `samples(idx)` returns per-sample dicts with RAW bytes —
+    decode happens in the loader's transform pool, where it
+    parallelizes; this class only does I/O.
+    """
+
+    def __init__(self, list_file: str | None = None, root: str = "",
+                 entries: Sequence[tuple[str, int]] | None = None):
+        if (list_file is None) == (entries is None):
+            raise EdlDataError(
+                "JpegFileListSource needs exactly one of list_file/entries")
+        if list_file is not None:
+            entries = []
+            with open(list_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    path, label = line.rsplit(None, 1)
+                    entries.append((path, int(label)))
+        if not entries:
+            raise EdlDataError("empty JPEG file list")
+        self.root = root
+        self.entries = list(entries)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def samples(self, idx: np.ndarray) -> list[dict]:
+        out = []
+        for i in idx:
+            path, label = self.entries[int(i)]
+            with open(os.path.join(self.root, path), "rb") as f:
+                out.append({"jpeg": f.read(),
+                            "label": np.int32(label)})
+        return out
+
+
+def make_synthetic_jpeg_dataset(directory: str, n: int, *,
+                                classes: int = 1000,
+                                hw: tuple[int, int] = (360, 480),
+                                seed: int = 0,
+                                quality: int = 90) -> str:
+    """Write n random JPEGs + train.txt under `directory`; returns the
+    list-file path. Sizes jitter around `hw` so crop paths see varied
+    shapes (real ImageNet is variable-sized)."""
+    os.makedirs(directory, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        h = int(hw[0] * rng.uniform(0.8, 1.25))
+        w = int(hw[1] * rng.uniform(0.8, 1.25))
+        img = rng.integers(0, 256, size=(h, w, 3), dtype=np.uint8)
+        name = f"img_{i:06d}.jpg"
+        with open(os.path.join(directory, name), "wb") as f:
+            f.write(encode_jpeg(img, quality))
+        lines.append(f"{name} {int(rng.integers(0, classes))}")
+    list_file = os.path.join(directory, "train.txt")
+    with open(list_file, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return list_file

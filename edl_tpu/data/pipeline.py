@@ -183,20 +183,38 @@ class DataLoader:
 
     Args:
       source: ArraySource or anything with __len__ + batch(indices)->dict.
+        With `sample_transforms`, the source must instead provide
+        `samples(indices) -> list[dict]` (per-sample records of raw,
+        possibly variable-size data — e.g. JPEG bytes).
       batch_size: per-RANK batch size.
       rank/world: this trainer's shard of the global order.
       seed: base shuffle seed; epoch is folded in per pass.
       transforms: callables (batch_dict, np.random.Generator) -> batch_dict,
         run on host after collation (augmentation hook); the generator is
         seeded per (epoch, rank) so augmentation replays after a restart.
+      sample_transforms: callables (sample_dict, np.random.Generator) ->
+        sample_dict run per sample BEFORE collation (the decode/augment
+        stage of the reference's xmap reader, reader_cv2.py:94-104) under
+        a `decode_threads`-wide pool. Determinism under the pool: every
+        sample's RNG seed is drawn from the epoch generator up front, so
+        worker scheduling cannot change the stream (unlike the
+        reference's `order=False` xmap with shared `random`).
+      decode_threads: pool width for sample_transforms (0 = inline). cv2
+        releases the GIL in decode/resize, so threads scale on real
+        multi-core hosts.
     """
 
     def __init__(self, source, batch_size: int, *, rank: int = 0,
                  world: int = 1, seed: int = 0, shuffle: bool = True,
                  drop_remainder: bool = True,
-                 transforms: Sequence[Callable] = ()):
+                 transforms: Sequence[Callable] = (),
+                 sample_transforms: Sequence[Callable] = (),
+                 decode_threads: int = 0):
         if world < 1 or not (0 <= rank < world):
             raise EdlDataError(f"bad shard rank={rank} world={world}")
+        if sample_transforms and not hasattr(source, "samples"):
+            raise EdlDataError(
+                "sample_transforms need a source with samples(indices)")
         self.source = source
         self.batch_size = batch_size
         self.rank = rank
@@ -205,6 +223,43 @@ class DataLoader:
         self.shuffle = shuffle
         self.drop_remainder = drop_remainder
         self.transforms = list(transforms)
+        self.sample_transforms = list(sample_transforms)
+        self.decode_threads = decode_threads
+        self._pool = None
+
+    def _decode_pool(self):
+        if self._pool is None and self.decode_threads > 0:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.decode_threads,
+                thread_name_prefix="data-decode")
+        return self._pool
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def _sample_batch(self, idx: np.ndarray,
+                      rng: np.random.Generator) -> dict[str, np.ndarray]:
+        """samples -> per-sample transforms (pooled) -> collate."""
+        samples = self.source.samples(idx)
+        # Seeds drawn BEFORE the pool runs: the stream is a pure function
+        # of (epoch, rank, position), whatever the thread interleaving.
+        seeds = rng.integers(0, 2**63, size=len(samples))
+
+        def work(args):
+            sample, seed = args
+            srng = np.random.default_rng(seed)
+            for t in self.sample_transforms:
+                sample = t(sample, srng)
+            return sample
+
+        pool = self._decode_pool()
+        done = list(pool.map(work, zip(samples, seeds))) if pool \
+            else [work(a) for a in zip(samples, seeds)]
+        keys = done[0].keys()
+        return {k: np.stack([d[k] for d in done]) for k in keys}
 
     def steps_per_epoch(self) -> int:
         shard = len(self.source) // self.world if self.drop_remainder \
@@ -231,7 +286,10 @@ class DataLoader:
             idx = mine[i * self.batch_size:(i + 1) * self.batch_size]
             if len(idx) == 0:
                 break
-            batch = self.source.batch(idx)
+            if self.sample_transforms:
+                batch = self._sample_batch(idx, rng)
+            else:
+                batch = self.source.batch(idx)
             for t in self.transforms:
                 batch = t(batch, rng)
             yield batch

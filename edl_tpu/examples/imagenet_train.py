@@ -103,8 +103,19 @@ def build_schedule(args, steps_per_epoch: int, world: int) -> optax.Schedule:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="edl_tpu.examples.imagenet_train")
     parser.add_argument("--data-dir", required=True)
+    parser.add_argument("--data-format", choices=("npz", "jpeg"),
+                        default="npz",
+                        help="npz: float shards; jpeg: a train.txt "
+                             "'<path> <label>' file list of JPEGs with "
+                             "host decode + random-resized-crop/flip "
+                             "(the reference's reader_cv2 path) and "
+                             "on-device normalization")
+    parser.add_argument("--decode-threads", type=int,
+                        default=max(1, (os.cpu_count() or 1) - 1),
+                        help="JPEG decode/augment pool width")
     parser.add_argument("--make-synthetic", type=int, default=0,
-                        help="generate N train shards (+1 val) first")
+                        help="generate N train shards (+1 val) first "
+                             "(jpeg format: N random JPEGs + train.txt)")
     parser.add_argument("--rows-per-file", type=int, default=1024)
     parser.add_argument("--model", default="ResNet50_vd",
                         help="zoo factory: ResNet50[_vd], ResNet101, VGG16, "
@@ -159,20 +170,22 @@ def main(argv=None) -> int:
     world = max(1, env.world_size)
     rank = max(0, env.rank)
     if args.make_synthetic and rank == 0:
-        make_synthetic_shards(args.data_dir, args.make_synthetic,
-                              args.rows_per_file, args.image_size,
-                              args.num_classes, args.seed)
+        if args.data_format == "jpeg":
+            from edl_tpu.data.image import make_synthetic_jpeg_dataset
+            make_synthetic_jpeg_dataset(
+                args.data_dir, args.make_synthetic,
+                classes=args.num_classes, seed=args.seed,
+                hw=(args.image_size * 3 // 2, args.image_size * 2))
+        else:
+            make_synthetic_shards(args.data_dir, args.make_synthetic,
+                                  args.rows_per_file, args.image_size,
+                                  args.num_classes, args.seed)
     if args.make_synthetic and jax.process_count() > 1:
         # non-writers must not listdir a half-written data dir
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("edl_imagenet_data_gen")
 
-    files = sorted(os.path.join(args.data_dir, f)
-                   for f in os.listdir(args.data_dir)
-                   if f.startswith("train-") and f.endswith(".npz"))
     val_path = os.path.join(args.data_dir, "val.npz")
-    if not files:
-        raise SystemExit(f"no train-*.npz shards under {args.data_dir}")
     if args.batch_size % world:
         raise SystemExit(f"global batch {args.batch_size} not divisible by "
                          f"world {world}")
@@ -180,14 +193,41 @@ def main(argv=None) -> int:
 
     mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": -1}))
     data_sharding = mesh_lib.data_sharding(mesh)
-    source = FileSource(files)
-    transforms = () if args.no_augment else (random_flip_lr, random_crop)
-    loader = DataLoader(source, local_bs, rank=rank, world=world,
-                        seed=args.seed, transforms=transforms)
+    normalize = None
+    if args.data_format == "jpeg":
+        from edl_tpu.data.image import (JpegFileListSource,
+                                        eval_image_transform,
+                                        train_image_transform)
+        list_file = os.path.join(args.data_dir, "train.txt")
+        if not os.path.exists(list_file):
+            raise SystemExit(f"no train.txt under {args.data_dir}")
+        source = JpegFileListSource(list_file, root=args.data_dir)
+        # --no-augment keeps the deterministic eval-style decode (for
+        # synthetic-label tasks that are not augmentation-invariant)
+        sample_t = (eval_image_transform(
+                        args.image_size, short=args.image_size * 8 // 7)
+                    if args.no_augment
+                    else train_image_transform(args.image_size))
+        loader = DataLoader(source, local_bs, rank=rank, world=world,
+                            seed=args.seed, sample_transforms=(sample_t,),
+                            decode_threads=args.decode_threads)
+        normalize = "imagenet"  # uint8 off the wire; normalize on chip
+        n_files = len(source)
+    else:
+        files = sorted(os.path.join(args.data_dir, f)
+                       for f in os.listdir(args.data_dir)
+                       if f.startswith("train-") and f.endswith(".npz"))
+        if not files:
+            raise SystemExit(f"no train-*.npz shards under {args.data_dir}")
+        source = FileSource(files)
+        transforms = () if args.no_augment else (random_flip_lr, random_crop)
+        loader = DataLoader(source, local_bs, rank=rank, world=world,
+                            seed=args.seed, transforms=transforms)
+        n_files = len(files)
     steps_per_epoch = loader.steps_per_epoch()
-    log.info("world=%d rank=%d devices=%d shards=%d samples=%d "
-             "steps/epoch=%d", world, rank, jax.device_count(), len(files),
-             len(source), steps_per_epoch)
+    log.info("world=%d rank=%d devices=%d format=%s shards=%d samples=%d "
+             "steps/epoch=%d", world, rank, jax.device_count(),
+             args.data_format, n_files, len(source), steps_per_epoch)
 
     from edl_tpu import models as zoo
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
@@ -213,13 +253,39 @@ def main(argv=None) -> int:
     step = make_classification_step(args.num_classes,
                                     smoothing=args.label_smoothing,
                                     mixup_alpha=args.mixup_alpha,
-                                    seed=args.seed)
-    eval_step = make_eval_step()
+                                    seed=args.seed, normalize=normalize)
+    eval_step = make_eval_step(normalize=normalize)
 
-    eval_data = None
-    if os.path.exists(val_path):
+    # eval_batches: None, or a zero-arg callable yielding {'image',
+    # 'label'} host batches of local_bs (streamed — a 50k-image val set
+    # must not be decoded serially into one giant resident array)
+    eval_batches = None
+    if args.data_format == "jpeg":
+        val_list = os.path.join(args.data_dir, "val.txt")
+        if os.path.exists(val_list):
+            vsrc = JpegFileListSource(val_list, root=args.data_dir)
+            if len(vsrc) >= local_bs:
+                vloader = DataLoader(
+                    vsrc, local_bs, shuffle=False,
+                    sample_transforms=(eval_image_transform(
+                        args.image_size,
+                        short=args.image_size * 8 // 7),),
+                    decode_threads=args.decode_threads)
+                eval_batches = lambda: vloader.epoch(0)  # noqa: E731
+            else:
+                log.warning("val.txt has %d < batch %d images — eval off",
+                            len(vsrc), local_bs)
+    elif os.path.exists(val_path):
         with np.load(val_path) as z:
             eval_data = {"image": z["image"], "label": z["label"]}
+
+        def _npz_eval_batches():
+            for lo in range(0, len(eval_data["label"]) - local_bs + 1,
+                            local_bs):
+                yield {k: v[lo:lo + local_bs]
+                       for k, v in eval_data.items()}
+
+        eval_batches = _npz_eval_batches
 
     blog = BenchmarkLog(args.model, batch_size=args.batch_size,
                         world_size=world)
@@ -231,15 +297,11 @@ def main(argv=None) -> int:
         # benchlog multiplies its max by world_size for the global figure
         rate = steps_per_epoch * local_bs / max(elapsed, 1e-9)
         results = {"examples_per_sec": rate}
-        if eval_data is not None:
+        if eval_batches is not None:
             accs, n = {"acc1": 0.0, "acc5": 0.0}, 0
-            for lo in range(0, len(eval_data["label"]) - local_bs + 1,
-                            local_bs):
-                ev = eval_step(state, {
-                    "image": jnp.asarray(
-                        eval_data["image"][lo:lo + local_bs]),
-                    "label": jnp.asarray(
-                        eval_data["label"][lo:lo + local_bs])})
+            for hb in eval_batches():
+                ev = eval_step(state, {"image": jnp.asarray(hb["image"]),
+                                       "label": jnp.asarray(hb["label"])})
                 for k in accs:
                     accs[k] += float(ev[k])
                 n += 1

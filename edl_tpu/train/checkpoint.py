@@ -418,22 +418,30 @@ class CheckpointManager:
             # actually HAS a complete copy before deleting the local dir
             # (it is this pod's only copy of its own chunks).
             from edl_tpu.utils import fs
+            # Fetch into a temp dir FIRST and only then swap: the local
+            # dir is this pod's only copy of its own chunks, so it must
+            # survive a fetch that fails mid-flight (remote GC race,
+            # transient transport error).
+            fetch_tmp = tempfile.mkdtemp(prefix=".tmp-refetch-",
+                                         dir=self.directory)
+            got = None
             try:
-                remote_complete = fs.remote_version_complete(self.remote,
-                                                             version)
-            except Exception:  # noqa: BLE001 — mirror-only
-                remote_complete = False
-            if remote_complete:
+                got = fs.fetch_latest_checkpoint(self.remote, fetch_tmp,
+                                                 version=version)
+            except Exception as exc:  # noqa: BLE001 — mirror-only
+                log.warning("mirror refetch of ckpt-%d failed: %s",
+                            version, exc)
+            if got is not None:
                 log.info("local %s incomplete for its saved world — "
-                         "refetching from mirror", path)
+                         "replaced with the mirror's complete copy", path)
                 shutil.rmtree(path, ignore_errors=True)
-                fs.fetch_latest_checkpoint(self.remote, self.directory,
-                                           version=version)
+                os.rename(os.path.join(fetch_tmp, f"ckpt-{version}"), path)
             else:
                 log.warning(
                     "local %s incomplete and mirror has no complete "
                     "copy — restoring from local (may fail coverage)",
                     path)
+            shutil.rmtree(fetch_tmp, ignore_errors=True)
         if sc.is_sharded_dir(path):
             state = sc.restore_sharded(path, target)
         else:

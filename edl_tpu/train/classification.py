@@ -24,6 +24,30 @@ from edl_tpu.train.state import TrainState
 from edl_tpu.train.step import make_train_step
 
 
+# Per-channel ImageNet statistics (reference img_tool.py:116-117), scaled
+# to the uint8 range because pixels ship as 1 byte/channel and normalize
+# ON DEVICE (the DALI recipe: float32 pixels would 4x the H2D bytes).
+IMAGENET_MEAN = (0.485 * 255.0, 0.456 * 255.0, 0.406 * 255.0)
+IMAGENET_STD = (0.229 * 255.0, 0.224 * 255.0, 0.225 * 255.0)
+
+
+def normalize_image(images: jax.Array, mode: str | None) -> jax.Array:
+    """On-device pixel normalization for uint8 NHWC batches.
+
+    None: passthrough (floats already normalized on host — the npz path);
+    'imagenet': per-channel (x - mean)/std with the reference's
+    constants; 'unit': x*(2/255) - 1."""
+    if mode is None:
+        return images
+    if mode == "imagenet":
+        mean = jnp.asarray(IMAGENET_MEAN, jnp.float32)
+        std = jnp.asarray(IMAGENET_STD, jnp.float32)
+        return (images.astype(jnp.float32) - mean) / std
+    if mode == "unit":
+        return images.astype(jnp.float32) * (2.0 / 255.0) - 1.0
+    raise ValueError(f"unknown normalize mode {mode!r}")
+
+
 def smoothed_labels(labels: jax.Array, num_classes: int,
                     smoothing: float = 0.0) -> jax.Array:
     """Integer labels -> (optionally smoothed) one-hot targets, fp32."""
@@ -87,16 +111,19 @@ def create_state(model, rng: jax.Array, input_shape: tuple,
 def make_classification_step(num_classes: int, *, smoothing: float = 0.0,
                              mixup_alpha: float = 0.0, seed: int = 0,
                              weight_decay_in_loss: float = 0.0,
+                             normalize: str | None = None,
                              donate: bool = True) -> Callable:
     """Jitted (state, batch)->(state, metrics) for {'image','label'} batches.
 
     Handles flax BN mutable batch_stats; mixup/smoothing optional. L2 can be
     added here (reference uses optimizer regularizer; prefer optax wd).
+    `normalize` runs on-device pixel normalization (see `normalize_image`)
+    so uint8 batches off the JPEG plane train directly.
     """
 
     def loss_fn(state: TrainState, params: Any, batch: dict):
         targets = smoothed_labels(batch["label"], num_classes, smoothing)
-        images = batch["image"]
+        images = normalize_image(batch["image"], normalize)
         if mixup_alpha > 0.0:
             key = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
             images, targets = mixup(key, images, targets, mixup_alpha)
@@ -156,7 +183,8 @@ def make_distill_step(num_classes: int, *, temperature: float = 1.0,
     return make_train_step(loss_fn, donate=donate)
 
 
-def make_eval_step(input_key: str = "image") -> Callable:
+def make_eval_step(input_key: str = "image",
+                   normalize: str | None = None) -> Callable:
     """Jitted eval: (state, batch) -> {'acc1','acc5'} (train=False)."""
 
     @jax.jit
@@ -164,7 +192,9 @@ def make_eval_step(input_key: str = "image") -> Callable:
         variables = {"params": state.params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
-        logits = state.apply_fn(variables, batch[input_key], train=False)
+        logits = state.apply_fn(
+            variables, normalize_image(batch[input_key], normalize),
+            train=False)
         return {"acc1": accuracy_topk(logits, batch["label"], 1),
                 "acc5": accuracy_topk(logits, batch["label"],
                                       min(5, logits.shape[-1]))}

@@ -296,25 +296,34 @@ def mirror_checkpoint_files(version_dir: str, version: int,
                   join_uri(remote_root, name, fname))
 
 
+_COMPLETE = "COMPLETE"
+
+
 def remote_version_complete(remote_root: str, version: int) -> bool:
-    """A remote version dir counts as complete once it holds meta.json —
-    the last file both mirror paths upload (the sharded path uploads it
-    in finalize after the index gate; the replicated path uploads the
-    sealed dir wholesale). A dir abandoned by a failed mirror lacks it."""
+    """A remote version dir counts as complete once it holds the
+    COMPLETE marker `finalize_mirror` writes AFTER all content is up.
+    meta.json presence would be unsound on CommandFS backends: a killed
+    mid-upload `gsutil cp -r` can land meta.json before the payload —
+    file order inside a recursive copy is unspecified."""
     fs = resolve(remote_root)
-    return fs.exists(join_uri(remote_root, f"ckpt-{version}", "meta.json"))
+    return fs.exists(join_uri(remote_root, f"ckpt-{version}", _COMPLETE))
 
 
 def finalize_mirror(remote_root: str, version: int, *,
                     keep: int | None = None) -> None:
-    """Flip LATEST to `version` (all files must already be up) + GC.
+    """Seal the remote version (COMPLETE marker) + flip LATEST + GC.
 
-    GC retention counts only COMPLETE versions — a partial dir left by a
-    failed earlier mirror must not occupy a retention slot (that would
-    delete an older complete version early); partials older than the
-    newest complete `keep` are deleted outright as garbage.
+    Both markers are written only after every content file is up:
+    COMPLETE makes the version individually fetchable (explicit-version
+    restores), LATEST names the newest one. GC retention counts only
+    COMPLETE versions — a partial dir left by a failed earlier mirror
+    must not occupy a retention slot (that would delete an older
+    complete version early); partials older than the newest complete
+    `keep` are deleted outright as garbage.
     """
     fs = resolve(remote_root)
+    fs.write_text(join_uri(remote_root, f"ckpt-{version}", _COMPLETE),
+                  str(version))
     fs.write_text(join_uri(remote_root, _LATEST), str(version))
     if keep is not None:
         versions = remote_versions(remote_root)

@@ -302,12 +302,13 @@ class TestCheckpointMirror:
         occupy a retention slot — and gets deleted once it falls below
         the newest-complete cutoff."""
         remote = str(tmp_path / "r")
-        for v in (0, 2, 3):
+        for v in (0, 2):  # complete: sealed by an earlier finalize
             os.makedirs(os.path.join(remote, f"ckpt-{v}"))
-            with open(os.path.join(remote, f"ckpt-{v}", "meta.json"),
+            with open(os.path.join(remote, f"ckpt-{v}", "COMPLETE"),
                       "w") as f:
-                json.dump({"version": v}, f)
-        os.makedirs(os.path.join(remote, "ckpt-1"))  # partial: no meta
+                f.write(str(v))
+        for v in (1, 3):  # 1 partial (no marker); 3 being finalized now
+            os.makedirs(os.path.join(remote, f"ckpt-{v}"))
         with open(os.path.join(remote, "ckpt-1", "index.0.json"), "w") as f:
             f.write("{}")
         fslib.finalize_mirror(remote, 3, keep=2)

@@ -1,0 +1,175 @@
+"""JPEG decode/augment input plane (data/image.py + DataLoader pool).
+
+Covers the reference's cv2 reader capability (reader_cv2.py file-list +
+xmap decode pool; img_tool.py transform set) with the determinism the
+reference lacks: identical streams across pool widths and restarts.
+"""
+
+import numpy as np
+import pytest
+
+from edl_tpu.data.image import (JpegFileListSource, center_crop, decode_jpeg,
+                                encode_jpeg, eval_image_transform,
+                                make_synthetic_jpeg_dataset,
+                                random_resized_crop, resize_short,
+                                train_image_transform)
+from edl_tpu.data.pipeline import DataLoader
+from edl_tpu.utils.exceptions import EdlDataError
+
+
+@pytest.fixture(scope="module")
+def jpeg_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("jpegs")
+    list_file = make_synthetic_jpeg_dataset(str(d), 24, classes=5,
+                                            hw=(80, 100), seed=3)
+    return str(d), list_file
+
+
+class TestCodecs:
+    def test_roundtrip_shape_dtype(self):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 256, (60, 40, 3), dtype=np.uint8)
+        out = decode_jpeg(encode_jpeg(img, quality=95))
+        assert out.shape == (60, 40, 3) and out.dtype == np.uint8
+
+    def test_decode_is_rgb(self):
+        # a pure-red image must come back red-dominant in channel 0
+        img = np.zeros((32, 32, 3), np.uint8)
+        img[..., 0] = 255  # RGB red
+        out = decode_jpeg(encode_jpeg(img, quality=95))
+        assert out[..., 0].mean() > 200 > out[..., 2].mean()
+
+    def test_decode_garbage_raises(self):
+        with pytest.raises(EdlDataError):
+            decode_jpeg(b"not a jpeg")
+
+
+class TestTransforms:
+    def test_random_resized_crop_shape_and_determinism(self):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 256, (90, 123, 3), dtype=np.uint8)
+        a = random_resized_crop(img, np.random.default_rng(7), 32)
+        b = random_resized_crop(img, np.random.default_rng(7), 32)
+        c = random_resized_crop(img, np.random.default_rng(8), 32)
+        assert a.shape == (32, 32, 3)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)  # different seed, different crop
+
+    def test_random_resized_crop_tiny_image(self):
+        img = np.zeros((3, 2, 3), np.uint8)  # smaller than the crop
+        out = random_resized_crop(img, np.random.default_rng(0), 16)
+        assert out.shape == (16, 16, 3)
+
+    def test_resize_short_and_center_crop(self):
+        img = np.zeros((100, 200, 3), np.uint8)
+        r = resize_short(img, 50)
+        assert min(r.shape[:2]) == 50 and r.shape[1] == 100
+        c = center_crop(r, 50)
+        assert c.shape == (50, 50, 3)
+
+    def test_eval_transform_is_deterministic(self):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 256, (70, 90, 3), dtype=np.uint8)
+        s = {"jpeg": encode_jpeg(img), "label": np.int32(2)}
+        t = eval_image_transform(size=32, short=40)
+        a = t(dict(s), np.random.default_rng(0))
+        b = t(dict(s), np.random.default_rng(99))
+        np.testing.assert_array_equal(a["image"], b["image"])
+        assert a["label"] == 2 and "jpeg" not in a
+
+
+class TestFileListSource:
+    def test_len_and_samples(self, jpeg_dir):
+        root, list_file = jpeg_dir
+        src = JpegFileListSource(list_file, root=root)
+        assert len(src) == 24
+        out = src.samples(np.array([0, 5, 23]))
+        assert len(out) == 3
+        for s in out:
+            assert isinstance(s["jpeg"], bytes) and s["jpeg"][:2] == b"\xff\xd8"
+            assert 0 <= int(s["label"]) < 5
+
+    def test_list_parsing_rejects_empty(self, tmp_path):
+        p = tmp_path / "empty.txt"
+        p.write_text("\n")
+        with pytest.raises(EdlDataError):
+            JpegFileListSource(str(p))
+
+    def test_entries_or_list_exclusive(self):
+        with pytest.raises(EdlDataError):
+            JpegFileListSource(None, entries=None)
+
+
+class TestLoaderIntegration:
+    def _loader(self, jpeg_dir, threads, seed=0):
+        root, list_file = jpeg_dir
+        src = JpegFileListSource(list_file, root=root)
+        return DataLoader(src, 8, seed=seed,
+                          sample_transforms=(train_image_transform(32),),
+                          decode_threads=threads)
+
+    def test_batch_shape_dtype(self, jpeg_dir):
+        loader = self._loader(jpeg_dir, threads=2)
+        batch = next(iter(loader.epoch(0)))
+        assert batch["image"].shape == (8, 32, 32, 3)
+        assert batch["image"].dtype == np.uint8
+        assert batch["label"].shape == (8,)
+        loader.close()
+
+    def test_pool_width_does_not_change_stream(self, jpeg_dir):
+        """Decode pool scheduling must be invisible: 0, 1 and 4 threads
+        produce bit-identical epochs (the reference's order=False xmap
+        cannot guarantee this — our elastic replay depends on it)."""
+        batches = {}
+        for threads in (0, 1, 4):
+            loader = self._loader(jpeg_dir, threads=threads)
+            batches[threads] = list(loader.epoch(2))
+            loader.close()
+        for threads in (1, 4):
+            assert len(batches[threads]) == len(batches[0])
+            for a, b in zip(batches[0], batches[threads]):
+                np.testing.assert_array_equal(a["image"], b["image"])
+                np.testing.assert_array_equal(a["label"], b["label"])
+
+    def test_restart_replays_epoch(self, jpeg_dir):
+        l1 = self._loader(jpeg_dir, threads=2)
+        l2 = self._loader(jpeg_dir, threads=2)
+        for a, b in zip(l1.epoch(1), l2.epoch(1)):
+            np.testing.assert_array_equal(a["image"], b["image"])
+        l1.close(), l2.close()
+
+    def test_epochs_differ(self, jpeg_dir):
+        loader = self._loader(jpeg_dir, threads=2)
+        a = next(iter(loader.epoch(0)))
+        b = next(iter(loader.epoch(1)))
+        assert not np.array_equal(a["image"], b["image"])
+        loader.close()
+
+    def test_sample_transforms_need_samples_api(self):
+        from edl_tpu.data.pipeline import ArraySource
+        src = ArraySource({"x": np.zeros((4, 2), np.float32)})
+        with pytest.raises(EdlDataError):
+            DataLoader(src, 2, sample_transforms=(lambda s, r: s,))
+
+
+class TestFlagshipJpegMode:
+    def test_imagenet_train_jpeg_end_to_end(self, tmp_path):
+        """The flagship trainer over the JPEG plane: synthetic JPEGs +
+        train.txt, pooled decode/augment, on-device normalization."""
+        from edl_tpu.examples.imagenet_train import main
+
+        data = str(tmp_path / "jpegs")
+        rc = main(["--data-dir", data, "--data-format", "jpeg",
+                   "--make-synthetic", "96", "--model", "ResNetTiny",
+                   "--num-classes", "4", "--image-size", "24",
+                   "--epochs", "2", "--batch-size", "32",
+                   "--warmup-epochs", "0", "--lr-strategy", "cosine",
+                   "--lr", "0.02", "--label-smoothing", "0",
+                   "--decode-threads", "2",
+                   "--ckpt-dir", str(tmp_path / "ckpt"),
+                   "--benchmark-log", str(tmp_path / "blog")])
+        assert rc == 0
+        import json
+        blog = json.load(open(tmp_path / "blog" / "log_0.json"))
+        assert len(blog["epochs"]) == 2
+        assert blog["epochs"][-1]["examples_per_sec"] > 0
