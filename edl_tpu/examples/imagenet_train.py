@@ -156,6 +156,10 @@ def main(argv=None) -> int:
                              "tasks are not augmentation-invariant)")
     parser.add_argument("--ckpt-dir", default="")
     parser.add_argument("--benchmark-log", default="")
+    parser.add_argument("--profile", default="",
+                        help="jax profiler trace dir; traces steps "
+                             "10-15 on rank 0 (reference --profile, "
+                             "train_with_fleet.py:521-530)")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
@@ -314,7 +318,8 @@ def main(argv=None) -> int:
         step, state, mesh=mesh,
         config=from_env(LoopConfig, num_epochs=args.epochs,
                         ckpt_dir=args.ckpt_dir or env.checkpoint_path
-                        or None),
+                        or None,
+                        profile_dir=args.profile or None),
         eval_fn=eval_fn,
         place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t))
 

@@ -93,6 +93,8 @@ def main(argv=None) -> int:
     parser.add_argument("--ckpt-dir", default="")
     parser.add_argument("--ckpt-sharded", action="store_true")
     parser.add_argument("--benchmark-log", default="")
+    parser.add_argument("--profile", default="",
+                        help="jax profiler trace dir (steps 10-15, rank 0)")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
@@ -207,7 +209,8 @@ def main(argv=None) -> int:
         step, state, mesh=mesh,
         config=from_env(LoopConfig, num_epochs=args.epochs,
                         ckpt_dir=args.ckpt_dir or env.checkpoint_path
-                        or None, ckpt_sharded=args.ckpt_sharded),
+                        or None, ckpt_sharded=args.ckpt_sharded,
+                        profile_dir=args.profile or None),
         eval_fn=eval_fn,
         place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t))
 

@@ -41,6 +41,13 @@ class LoopConfig:
     # Remote mirror URI (gs://, hdfs://, file://) — rank 0 uploads each
     # sealed version, cold pods fetch before restore (utils/fs.py).
     ckpt_remote: str | None = field(None, env="EDL_TPU_CKPT_REMOTE")
+    # jax.profiler trace window (the reference's --profile traces batches
+    # 100-105 on trainer 0, train_with_fleet.py:521-530): when
+    # profile_dir is set, rank 0 captures [profile_start_step,
+    # profile_start_step + profile_steps) global steps.
+    profile_dir: str | None = field(None, env="EDL_TPU_PROFILE_DIR")
+    profile_start_step: int = field(10, env="EDL_TPU_PROFILE_START")
+    profile_steps: int = field(5, env="EDL_TPU_PROFILE_STEPS")
 
 
 class TrainLoop:
@@ -82,6 +89,7 @@ class TrainLoop:
                                        remote=self.config.ckpt_remote)
                      if self.config.ckpt_dir else None)
         self.last_metrics: dict = {}
+        self._profiling = False
         # World size recorded in the restored checkpoint, set by
         # try_restore(); None until a restore happens. Consumers use it to
         # rescale LR/batch after an elastic resize (lr.scale_for_world).
@@ -145,7 +153,34 @@ class TrainLoop:
             if self.eval_fn is not None:
                 results = self.eval_fn(self.state, epoch)
                 log.info("eval epoch %d: %s", epoch, _fmt(results))
+        if self._profiling:  # run shorter than the window: still flush
+            jax.profiler.stop_trace()
+            self._profiling = False
         return self.status
+
+    def _profile_window(self) -> None:
+        """Start/stop the jax profiler trace at the configured global
+        steps (rank 0 only — one host's trace is the analysis unit)."""
+        cfg = self.config
+        if cfg.profile_dir is None or jax.process_index() != 0:
+            return
+        if self.status.step == cfg.profile_start_step \
+                and not self._profiling:
+            log.info("profiler: tracing steps %d..%d -> %s",
+                     cfg.profile_start_step,
+                     cfg.profile_start_step + cfg.profile_steps,
+                     cfg.profile_dir)
+            jax.profiler.start_trace(cfg.profile_dir)
+            self._profiling = True
+        elif self._profiling and self.status.step >= \
+                cfg.profile_start_step + cfg.profile_steps:
+            # force pending dispatches to land inside the trace
+            jax.tree.map(lambda x: x.block_until_ready()
+                         if hasattr(x, "block_until_ready") else x,
+                         self.last_metrics)
+            jax.profiler.stop_trace()
+            self._profiling = False
+            log.info("profiler: trace written to %s", cfg.profile_dir)
 
     def _run_epoch(self, epoch: int, data_fn, batch_size_fn) -> None:
         cfg = self.config
@@ -164,6 +199,7 @@ class TrainLoop:
         for i, batch in enumerate(data_fn(epoch)):
             if i < skip:
                 continue
+            self._profile_window()
             batch = self._place(batch)
             self.state, metrics = self.step_fn(self.state, batch)
             self.status.step += 1

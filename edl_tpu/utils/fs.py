@@ -302,11 +302,15 @@ _COMPLETE = "COMPLETE"
 def remote_version_complete(remote_root: str, version: int) -> bool:
     """A remote version dir counts as complete once it holds the
     COMPLETE marker `finalize_mirror` writes AFTER all content is up.
-    meta.json presence would be unsound on CommandFS backends: a killed
-    mid-upload `gsutil cp -r` can land meta.json before the payload —
-    file order inside a recursive copy is unspecified."""
+    meta.json presence alone would be unsound on CommandFS backends — a
+    killed mid-upload `gsutil cp -r` can land meta.json before the
+    payload (file order inside a recursive copy is unspecified) — but is
+    accepted as a LEGACY fallback so mirrors sealed before the marker
+    existed stay restorable (they were written under the old contract)."""
     fs = resolve(remote_root)
-    return fs.exists(join_uri(remote_root, f"ckpt-{version}", _COMPLETE))
+    name = f"ckpt-{version}"
+    return (fs.exists(join_uri(remote_root, name, _COMPLETE))
+            or fs.exists(join_uri(remote_root, name, "meta.json")))
 
 
 def finalize_mirror(remote_root: str, version: int, *,
