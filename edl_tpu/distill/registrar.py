@@ -97,10 +97,17 @@ class TeacherRegistrar:
                           dt: float) -> str:
         d_rows = cur["served_rows"] - (prev or {}).get("served_rows", 0)
         d_busy = cur["busy_s"] - (prev or {}).get("busy_s", 0.0)
+        # coalescing effectiveness over THIS window (mean device-batch
+        # rows): a windowed delta like its siblings — a lifetime mean
+        # would hide a teacher degrading to degenerate 1-request batches
+        d_groups = (sum(cur.get("batch_rows_hist", {}).values())
+                    - sum((prev or {}).get("batch_rows_hist", {}).values()))
         return json.dumps({
             "rows_per_sec": round(d_rows / max(dt, 1e-9), 1),
             "util": round(min(1.0, d_busy / max(dt, 1e-9)), 3),
             "queue_depth": cur.get("queue_depth", 0),
+            "batch_rows_mean": round(d_rows / d_groups, 2) if d_groups
+            else 0.0,
         }, sort_keys=True)
 
     def _stats_loop(self) -> None:

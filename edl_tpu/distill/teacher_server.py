@@ -76,6 +76,12 @@ class Batcher:
         self._served_requests = 0
         self._busy_s = 0.0
         self._started_at = time.monotonic()
+        # Coalescing histogram: device-batch ROW count (pre-padding) ->
+        # number of served groups. Whether concurrent client requests
+        # actually merge (vs degenerate 1-request batches) is THE
+        # efficiency question for a serving pool; the histogram makes it
+        # observable instead of inferred.
+        self._batch_hist: dict[int, int] = {}
 
     def start(self) -> "Batcher":
         self._thread.start()
@@ -156,6 +162,7 @@ class Batcher:
             self._busy_s += time.monotonic() - t0
             self._served_rows += rows
             self._served_requests += len(group)
+            self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
         offset = 0
         for req in group:
             req.result = {k: v[offset:offset + req.rows]
@@ -166,11 +173,18 @@ class Batcher:
     def stats(self) -> dict:
         """Cumulative serving counters (consumed by TeacherRegistrar)."""
         with self._stats_lock:
+            hist = dict(sorted(self._batch_hist.items()))
+            groups = sum(hist.values())
+            rows_mean = (sum(r * c for r, c in hist.items()) / groups
+                         if groups else 0.0)
             return {"served_rows": self._served_rows,
                     "served_requests": self._served_requests,
                     "busy_s": round(self._busy_s, 4),
                     "uptime_s": round(time.monotonic() - self._started_at, 4),
-                    "queue_depth": self._q.qsize()}
+                    "queue_depth": self._q.qsize(),
+                    # JSON object keys are strings on the wire
+                    "batch_rows_hist": {str(r): c for r, c in hist.items()},
+                    "batch_rows_mean": round(rows_mean, 2)}
 
     def stop(self) -> None:
         self._stop.set()

@@ -174,10 +174,10 @@ class TrainLoop:
             self._profiling = True
         elif self._profiling and self.status.step >= \
                 cfg.profile_start_step + cfg.profile_steps:
-            # force pending dispatches to land inside the trace
-            jax.tree.map(lambda x: x.block_until_ready()
-                         if hasattr(x, "block_until_ready") else x,
-                         self.last_metrics)
+            # force pending dispatches to land inside the trace; the
+            # state is the live device data (last_metrics is already
+            # host numpy by the time it's stored)
+            jax.block_until_ready(self.state)
             jax.profiler.stop_trace()
             self._profiling = False
             log.info("profiler: trace written to %s", cfg.profile_dir)

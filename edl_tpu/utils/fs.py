@@ -302,15 +302,16 @@ _COMPLETE = "COMPLETE"
 def remote_version_complete(remote_root: str, version: int) -> bool:
     """A remote version dir counts as complete once it holds the
     COMPLETE marker `finalize_mirror` writes AFTER all content is up.
-    meta.json presence alone would be unsound on CommandFS backends — a
-    killed mid-upload `gsutil cp -r` can land meta.json before the
-    payload (file order inside a recursive copy is unspecified) — but is
-    accepted as a LEGACY fallback so mirrors sealed before the marker
-    existed stay restorable (they were written under the old contract)."""
+    The marker is the ONLY accepted evidence: meta.json presence is
+    unsound on CommandFS backends (a killed mid-upload `gsutil cp -r`
+    can land meta.json before the payload — file order inside a
+    recursive copy is unspecified), and no heuristic can distinguish a
+    pre-marker legacy dir from a killed new-format upload. A mirror
+    sealed before the marker existed needs a one-time backfill:
+    `resolve(root).exists(...)` the content, then
+    `fs.write_text(join_uri(root, "ckpt-N", "COMPLETE"), "N")`."""
     fs = resolve(remote_root)
-    name = f"ckpt-{version}"
-    return (fs.exists(join_uri(remote_root, name, _COMPLETE))
-            or fs.exists(join_uri(remote_root, name, "meta.json")))
+    return fs.exists(join_uri(remote_root, f"ckpt-{version}", _COMPLETE))
 
 
 def finalize_mirror(remote_root: str, version: int, *,

@@ -118,8 +118,10 @@ def test_utilization_published_and_surfaced():
                 break
             time.sleep(0.05)
         doc = json.loads(info)
-        assert {"rows_per_sec", "util", "queue_depth"} <= set(doc)
+        assert {"rows_per_sec", "util", "queue_depth",
+                "batch_rows_mean"} <= set(doc)
         assert doc["rows_per_sec"] >= 0.0
+        assert doc["batch_rows_mean"] >= 0.0
 
         # Surfaced through the discovery server's stats op.
         disco = DiscoveryServer(store, host="127.0.0.1",
