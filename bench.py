@@ -495,16 +495,19 @@ def bench_distill(on_tpu: bool) -> dict:
         c0 = TeacherClient(endpoint, timeout=120.0)
         c0.predict({"image": img})
         c0.close()
-        served = []
+        served, client_errs = [], []
 
         def client():
-            c = TeacherClient(endpoint, timeout=120.0)
-            n = 0
-            for _ in range(reqs_per_client):
-                out = c.predict({"image": img})
-                n += len(out["logits"])
-            c.close()
-            served.append(n)
+            try:
+                c = TeacherClient(endpoint, timeout=120.0)
+                n = 0
+                for _ in range(reqs_per_client):
+                    out = c.predict({"image": img})
+                    n += len(out["logits"])
+                c.close()
+                served.append(n)
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                client_errs.append(exc)
 
         threads = [threading.Thread(target=client)
                    for _ in range(n_clients)]
@@ -514,6 +517,11 @@ def bench_distill(on_tpu: bool) -> dict:
         for t in threads:
             t.join()
         tdt = time.perf_counter() - t0
+        if client_errs or len(served) != n_clients:
+            # a silently-dead client would deflate the published number
+            raise RuntimeError(
+                f"teacher bench client failure ({len(served)}/"
+                f"{n_clients} finished): {client_errs[:1]}")
         teacher_imgs_per_sec = sum(served) / tdt
     finally:
         server.stop()

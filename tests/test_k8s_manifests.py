@@ -1,0 +1,109 @@
+"""deploy/k8s manifests stay structurally valid (tools/validate_k8s.py).
+
+The reference shipped raw yaml with no gate; here the validator runs in
+the suite (and CI) so a typo'd selector, dangling service reference, or
+unparseable resource quantity fails before any deploy.
+"""
+
+import os
+import sys
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from validate_k8s import validate_dir  # noqa: E402
+
+K8S_DIR = os.path.join(REPO, "deploy", "k8s")
+
+
+def test_bundle_is_valid():
+    assert validate_dir(K8S_DIR) == []
+
+
+def test_validator_catches_selector_mismatch(tmp_path):
+    (tmp_path / "bad.yaml").write_text("""
+apiVersion: apps/v1
+kind: Deployment
+metadata: {name: d}
+spec:
+  selector: {matchLabels: {app: x}}
+  template:
+    metadata: {labels: {app: y}}
+    spec:
+      containers: [{name: c, image: i}]
+""")
+    errs = validate_dir(str(tmp_path))
+    assert any("selector" in e for e in errs), errs
+
+
+def test_validator_catches_missing_image(tmp_path):
+    (tmp_path / "bad.yaml").write_text("""
+apiVersion: v1
+kind: Pod
+metadata: {name: p}
+spec:
+  containers: [{name: c}]
+""")
+    errs = validate_dir(str(tmp_path))
+    assert any("without image" in e for e in errs), errs
+
+
+def test_validator_catches_bad_quantity(tmp_path):
+    (tmp_path / "bad.yaml").write_text("""
+apiVersion: v1
+kind: Pod
+metadata: {name: p}
+spec:
+  containers:
+    - name: c
+      image: i
+      resources: {requests: {cpu: lots}}
+""")
+    errs = validate_dir(str(tmp_path))
+    assert any("unparseable resource" in e for e in errs), errs
+
+
+def test_jobset_containers_checked(tmp_path):
+    (tmp_path / "js.yaml").write_text("""
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata: {name: j}
+spec:
+  replicatedJobs:
+    - name: w
+      template:
+        spec:
+          template:
+            spec:
+              containers: [{name: c}]
+""")
+    errs = validate_dir(str(tmp_path))
+    assert any("without image" in e for e in errs), errs
+
+
+def test_jobset_rank_env_contract():
+    """The JobSet variant must feed the launcher env contract
+    (EDL_TPU_RANK from the completion index, coordinator on job 0)."""
+    with open(os.path.join(K8S_DIR, "train-jobset.yaml")) as f:
+        doc = yaml.safe_load(f)
+    rj = doc["spec"]["replicatedJobs"][0]
+    tmpl = rj["template"]["spec"]
+    assert tmpl["parallelism"] == tmpl["completions"]
+    env = {e["name"]: e for e in
+           tmpl["template"]["spec"]["containers"][0]["env"]}
+    assert "job-completion-index" in str(
+        env["EDL_TPU_RANK"]["valueFrom"]["fieldRef"]["fieldPath"])
+    assert int(env["EDL_TPU_WORLD_SIZE"]["value"]) == tmpl["completions"]
+    assert "EDL_TPU_COORDINATOR" in env
+
+
+@pytest.mark.parametrize("fname", ["train-job.yaml", "train-jobset.yaml",
+                                   "edl-store.yaml",
+                                   "distill-serving.yaml"])
+def test_each_file_parses(fname):
+    with open(os.path.join(K8S_DIR, fname)) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    assert docs

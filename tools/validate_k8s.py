@@ -1,0 +1,203 @@
+"""Hermetic structural validation of deploy/k8s manifests.
+
+The reference ships raw yaml (k8s/edl_controller.yaml etc.) with no
+validation gate; a typo'd selector or a dangling Service reference only
+surfaces at deploy time. kubeconform/kubectl need network or a cluster —
+neither exists in CI here — so this checks the invariants that actually
+bite, offline:
+
+- every document parses and carries apiVersion/kind/metadata.name;
+- workload selectors (Deployment/StatefulSet/Job) match their pod
+  template labels — the classic silent-empty-ReplicaSet mistake;
+- container names are unique per pod; every container has an image;
+- StatefulSet.serviceName and any in-bundle DNS references
+  (`<name>.<svc>.<ns>` / `<svc>:<port>`) resolve to a Service defined in
+  the bundle, and the port exists on it;
+- resource quantities and port numbers parse;
+- namespaced objects agree with the bundle's Namespace.
+
+Run directly (`python tools/validate_k8s.py [dir]`) or via
+tests/test_k8s_manifests.py (CI).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+import yaml
+
+WORKLOAD_KINDS = {"Deployment", "StatefulSet", "Job", "DaemonSet"}
+QTY_RE = re.compile(r"^\d+(\.\d+)?(m|k|Ki|Mi|Gi|Ti|M|G|T)?$")
+
+
+def _fail(errors: list, doc_id: str, msg: str) -> None:
+    errors.append(f"{doc_id}: {msg}")
+
+
+def _pod_spec(doc: dict):
+    kind = doc.get("kind")
+    spec = doc.get("spec", {})
+    if kind in WORKLOAD_KINDS:
+        return spec.get("template", {}).get("spec", {})
+    if kind == "JobSet":
+        return None  # handled per replicatedJob
+    if kind == "Pod":
+        return spec
+    return None
+
+
+def _check_containers(errors, doc_id, pod_spec):
+    containers = (pod_spec.get("initContainers", [])
+                  + pod_spec.get("containers", []))
+    if not pod_spec.get("containers"):
+        _fail(errors, doc_id, "no containers in pod spec")
+        return
+    names = [c.get("name") for c in containers]
+    if len(set(names)) != len(names):
+        _fail(errors, doc_id, f"duplicate container names {names}")
+    for c in containers:
+        if not c.get("name"):
+            _fail(errors, doc_id, "container without name")
+        if not c.get("image"):
+            _fail(errors, doc_id,
+                  f"container {c.get('name')!r} without image")
+        for kind2 in ("requests", "limits"):
+            for key, val in (c.get("resources", {})
+                             .get(kind2, {}) or {}).items():
+                if not QTY_RE.match(str(val)):
+                    _fail(errors, doc_id,
+                          f"unparseable resource {key}={val!r}")
+        for port in c.get("ports", []) or []:
+            cp = port.get("containerPort")
+            if not isinstance(cp, int) or not 0 < cp < 65536:
+                _fail(errors, doc_id, f"bad containerPort {cp!r}")
+
+
+def _check_selector(errors, doc_id, doc):
+    labels = (doc.get("spec", {}).get("template", {})
+              .get("metadata", {}).get("labels", {}))
+    want = doc.get("spec", {}).get("selector", {}).get("matchLabels", {})
+    if not want:
+        # Jobs get a controller-generated selector; the others silently
+        # manage zero pods without one.
+        if doc.get("kind") != "Job":
+            _fail(errors, doc_id, "workload without selector.matchLabels")
+        return
+    for k, v in want.items():
+        if labels.get(k) != v:
+            _fail(errors, doc_id,
+                  f"selector {k}={v} not in template labels {labels}")
+
+
+def _service_ports(doc) -> set:
+    out = set()
+    for port in doc.get("spec", {}).get("ports", []) or []:
+        if "port" in port:
+            out.add(int(port["port"]))
+    return out
+
+
+def _collect_dns_refs(obj, refs):
+    """Find '<host>:<port>' strings in args/env that look like in-bundle
+    service DNS (contain a dot-name matching our service conventions)."""
+    if isinstance(obj, dict):
+        for v in obj.values():
+            _collect_dns_refs(v, refs)
+    elif isinstance(obj, list):
+        for v in obj:
+            _collect_dns_refs(v, refs)
+    elif isinstance(obj, str):
+        for m in re.finditer(r"([a-z0-9-]+(?:\.[a-z0-9-]+)+):(\d+)", obj):
+            refs.append((m.group(1), int(m.group(2))))
+
+
+def validate_dir(directory: str) -> list[str]:
+    import glob
+    import os
+
+    errors: list[str] = []
+    docs = []
+    for path in sorted(glob.glob(os.path.join(directory, "*.yaml"))):
+        try:
+            with open(path) as f:
+                for i, doc in enumerate(yaml.safe_load_all(f)):
+                    if doc is None:
+                        continue
+                    docs.append((f"{os.path.basename(path)}[{i}]", doc))
+        except yaml.YAMLError as exc:
+            errors.append(f"{os.path.basename(path)}: yaml parse: {exc}")
+    if not docs:
+        errors.append(f"no yaml documents under {directory}")
+        return errors
+
+    services = {}
+    namespaces = set()
+    for doc_id, doc in docs:
+        for key in ("apiVersion", "kind"):
+            if not doc.get(key):
+                _fail(errors, doc_id, f"missing {key}")
+        name = doc.get("metadata", {}).get("name")
+        if not name:
+            _fail(errors, doc_id, "missing metadata.name")
+        if doc.get("kind") == "Namespace":
+            namespaces.add(name)
+        if doc.get("kind") == "Service":
+            ns = doc.get("metadata", {}).get("namespace", "default")
+            services[(name, ns)] = _service_ports(doc)
+
+    for doc_id, doc in docs:
+        kind = doc.get("kind")
+        ns = doc.get("metadata", {}).get("namespace")
+        if ns and namespaces and ns not in namespaces and ns != "default":
+            _fail(errors, doc_id,
+                  f"namespace {ns!r} not defined in bundle")
+        pod_spec = _pod_spec(doc)
+        if pod_spec is not None:
+            _check_containers(errors, doc_id, pod_spec)
+        if kind in WORKLOAD_KINDS:
+            _check_selector(errors, doc_id, doc)
+        if kind == "JobSet":
+            for rj in doc.get("spec", {}).get("replicatedJobs", []) or []:
+                rj_id = f"{doc_id}/replicatedJob[{rj.get('name')}]"
+                tmpl = (rj.get("template", {}).get("spec", {})
+                        .get("template", {}).get("spec", {}))
+                _check_containers(errors, rj_id, tmpl)
+        if kind == "StatefulSet":
+            svc = doc.get("spec", {}).get("serviceName")
+            if svc and not any(n == svc for (n, _) in services):
+                _fail(errors, doc_id,
+                      f"serviceName {svc!r} has no Service in bundle")
+
+        refs: list[tuple[str, int]] = []
+        _collect_dns_refs(doc.get("spec"), refs)
+        for host, port in refs:
+            parts = host.split(".")
+            # conventions: pod-0.<svc>.<ns> or <svc>.<ns>
+            candidates = {parts[0]}
+            if "-" in parts[0]:
+                candidates.add(parts[0].rsplit("-", 1)[0])
+            if len(parts) > 1:
+                candidates.add(parts[1])
+            in_bundle = [k for k in services if k[0] in candidates]
+            if not in_bundle:
+                continue  # external host: not ours to validate
+            if not any(port in services[k] for k in in_bundle):
+                _fail(errors, doc_id,
+                      f"reference {host}:{port} — no matching Service "
+                      f"port in bundle")
+    return errors
+
+
+def main(argv=None) -> int:
+    directory = (argv or sys.argv[1:] or ["deploy/k8s"])[0]
+    errors = validate_dir(directory)
+    if errors:
+        print("\n".join(errors))
+        return 1
+    print(f"ok: {directory} manifests structurally valid")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
