@@ -1,0 +1,234 @@
+"""North-star resize clause at MODEL scale on the real chip.
+
+Runs the BASELINE.md clause — ResNet50_vd at 224 px surviving >= 2
+elastic resize events with < 1% acc1 loss vs an unresized run — with the
+flagship model on real TPU, mirroring
+tests/test_imagenet_multipod.py::test_two_resizes_under_one_percent_acc_loss
+(which proves the same invariant at ResNetTiny/16px scale on a CPU
+world). Each resize is a stop-resume generation under the REAL elastic
+launcher (store server + collective.launch + checkpoint restore +
+--schedule-epochs pinning every phase to one cosine horizon) — the
+reference's resize mechanism IS stop-resume (doc/edl_collective_design_
+doc.md:10-16: on membership change all trainers are killed and re-formed
+from the checkpoint), so generation boundaries are exactly what a
+world-size change exercises; with one chip the re-formed world keeps
+size 1, and the world-size-varying half of the clause is proven by the
+CPU test above.
+
+Writes NORTHSTAR_r{round}.json:
+    {"straight_acc1": ..., "resized_acc1": ..., "delta": ...,
+     "phases": [...], "config": {...}}
+
+Usage (on the TPU host):  python tools/northstar_tpu.py --out NORTHSTAR_r4.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/northstar_tpu.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
+TRAINER = "edl_tpu.examples.imagenet_train"
+
+
+def run(cmd, env=None, timeout=1800, log_path=None):
+    log = open(log_path, "wb") if log_path else None
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout,
+                              stdout=log or subprocess.PIPE,
+                              stderr=subprocess.STDOUT, cwd=REPO)
+    finally:
+        if log:
+            log.close()
+    if proc.returncode != 0:
+        tail = ""
+        if log_path and os.path.exists(log_path):
+            with open(log_path, "rb") as f:
+                tail = f.read()[-4000:].decode(errors="replace")
+        raise SystemExit(f"command failed ({proc.returncode}): "
+                         f"{' '.join(cmd)}\n{tail}")
+    return proc
+
+
+def trainer_args(a, work, epochs, schedule_epochs, blog, ckpt=None):
+    args = [sys.executable, "-m", TRAINER,
+            "--data-dir", os.path.join(work, "data"),
+            "--model", "ResNet50_vd", "--num-classes", str(a.classes),
+            "--image-size", "224", "--epochs", str(epochs),
+            "--batch-size", str(a.batch_size), "--warmup-epochs", "1",
+            "--lr-strategy", "cosine", "--lr", str(a.lr), "--no-augment",
+            "--label-smoothing", "0", "--bf16",
+            "--benchmark-log", blog]
+    if schedule_epochs:
+        args += ["--schedule-epochs", str(schedule_epochs)]
+    if ckpt:
+        args += ["--ckpt-dir", ckpt]
+    return args
+
+
+def launcher_run(a, work, tag, epochs, schedule_epochs, ckpt, port):
+    """One elastic GENERATION: the real launcher forms the world, spawns
+    the trainer, and the trainer resumes the shared checkpoint."""
+    blog = os.path.join(work, f"blog-{tag}")
+    env = dict(os.environ)
+    env["EDL_TPU_JOB_ID"] = f"northstar-{tag}"
+    cmd = [sys.executable, "-m", "edl_tpu.collective.launch",
+           "--store", f"127.0.0.1:{port}", "--nodes-range", "1:1",
+           "--log-dir", os.path.join(work, f"log-{tag}"), "--"]
+    cmd += trainer_args(a, work, epochs, schedule_epochs, blog, ckpt)
+    run(cmd, env=env, timeout=a.phase_timeout,
+        log_path=os.path.join(work, f"{tag}.launch.log"))
+    with open(os.path.join(blog, "log_0.json")) as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tools/northstar_tpu.py")
+    p.add_argument("--out", default="NORTHSTAR_r4.json")
+    p.add_argument("--workdir", default="/tmp/edl_northstar")
+    p.add_argument("--classes", type=int, default=8)
+    p.add_argument("--shards", type=int, default=6)
+    p.add_argument("--rows-per-file", type=int, default=256)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--label-noise", type=float, default=0.06,
+                   help="flipped-label fraction in the synthetic data: "
+                        "pins the val acc1 ceiling at ~1-x (template "
+                        "tasks at 224px are separable at any SNR, so "
+                        "without it both runs saturate at 1.0 and the "
+                        "<1%% comparison is vacuous)")
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--phase-timeout", type=int, default=1800)
+    a = p.parse_args(argv)
+    if a.epochs < 3:
+        # mid1 < mid2 < epochs must hold or a phase trains zero epochs
+        # and the run dies late with an opaque missing-'final' error
+        raise SystemExit("--epochs must be >= 3 (two resize points need "
+                         "three non-empty phases)")
+
+    work = a.workdir
+    os.makedirs(work, exist_ok=True)
+    # a prior invocation's checkpoint would make p1 resume past its stop
+    # epoch and train nothing; phase evidence must come from THIS run
+    import shutil
+    for stale in ("ckpt", "blog-straight", "blog-p1", "blog-p2",
+                  "blog-p3"):
+        shutil.rmtree(os.path.join(work, stale), ignore_errors=True)
+
+    # data once (deterministic; last shard is val.npz). Regenerate if a
+    # prior invocation used ANY different data parameter (marker file
+    # records the full recipe — reusing stale data would make the
+    # report's config block misdescribe what was trained on).
+    marker = os.path.join(work, "data", ".data_recipe")
+    want = (f"noise={a.label_noise:.4f} classes={a.classes} "
+            f"shards={a.shards} rows={a.rows_per_file}")
+    have = (open(marker).read().strip()
+            if os.path.exists(marker) else None)
+    if not os.path.exists(os.path.join(work, "data", "val.npz")) \
+            or have != want:
+        shutil.rmtree(os.path.join(work, "data"), ignore_errors=True)
+        run([sys.executable, "-m", TRAINER,
+             "--data-dir", os.path.join(work, "data"),
+             "--make-synthetic", str(a.shards),
+             "--rows-per-file", str(a.rows_per_file),
+             "--synthetic-label-noise", str(a.label_noise),
+             "--model", "ResNet50_vd", "--num-classes", str(a.classes),
+             "--image-size", "224", "--epochs", "0",
+             "--batch-size", str(a.batch_size)],
+            log_path=os.path.join(work, "datagen.log"))
+        with open(marker, "w") as f:
+            f.write(want)
+
+    # straight run: no launcher, no resumes, same horizon
+    t0 = time.time()
+    blog_s = os.path.join(work, "blog-straight")
+    run(trainer_args(a, work, a.epochs, 0, blog_s),
+        timeout=a.phase_timeout,
+        log_path=os.path.join(work, "straight.log"))
+    with open(os.path.join(blog_s, "log_0.json")) as f:
+        straight = json.load(f)
+
+    # elastic run: store + 3 launcher generations (2 resize events),
+    # all phases riding ONE cosine horizon via --schedule-epochs
+    from edl_tpu.utils import net
+    port = net.free_port()
+    store = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.coord.server", "--port", str(port)],
+        stdout=open(os.path.join(work, "store.log"), "wb"),
+        stderr=subprocess.STDOUT, cwd=REPO)
+    try:
+        from edl_tpu.coord.client import StoreClient
+        deadline = time.time() + 20
+        while True:  # poll readiness (a bare sleep races slow startups)
+            try:
+                StoreClient(f"127.0.0.1:{port}").ping()
+                break
+            except Exception:
+                if time.time() > deadline:
+                    raise SystemExit("store server did not come up")
+                time.sleep(0.25)
+        ckpt = os.path.join(work, "ckpt")
+        mid1 = max(1, a.epochs // 2)
+        mid2 = max(mid1 + 1, a.epochs - 1)
+        phases = []
+        for tag, epochs in (("p1", mid1), ("p2", mid2), ("p3", a.epochs)):
+            blog = launcher_run(a, work, tag, epochs, a.epochs, ckpt, port)
+            phases.append({"tag": tag, "stop_epoch": epochs,
+                           "epochs_trained":
+                               [e["epoch"] for e in blog["epochs"]],
+                           "final": blog["final"]})
+        resized = phases[-1]["final"]
+    finally:
+        store.kill()
+
+    # every phase must have RESUMED (trained only its own epochs) — a
+    # silent restore failure would make the comparison vacuous
+    for ph, lo in zip(phases, [0, mid1, mid2]):
+        if not ph["epochs_trained"] or ph["epochs_trained"][0] != lo:
+            raise SystemExit(f"phase {ph['tag']} did not resume: trained "
+                             f"{ph['epochs_trained']}, expected start {lo}")
+
+    acc_s = straight["final"]["acc1"]
+    acc_r = resized["acc1"]
+    # A straight run pinned at 1.0 makes the <1% comparison vacuous (a
+    # restore bug that re-memorizes still matches); require the straight
+    # run to land BELOW the ceiling so the delta is discriminating.
+    saturated = acc_s >= 1.0
+    report = {
+        "clause": "ResNet50_vd 224px, >=2 resize events, <1% acc1 loss",
+        "straight_acc1": acc_s,
+        "resized_acc1": acc_r,
+        "delta": round(abs(acc_s - acc_r), 5),
+        "saturated": saturated,
+        "pass": (abs(acc_s - acc_r) < 0.01 and acc_s > 0.8
+                 and not saturated),
+        "phases": phases,
+        "straight": straight["final"],
+        "config": {"model": "ResNet50_vd", "image_size": 224,
+                   "classes": a.classes, "batch_size": a.batch_size,
+                   "epochs": a.epochs, "lr": a.lr,
+                   "label_noise": a.label_noise,
+                   "val_acc_ceiling": round(1.0 - a.label_noise, 4),
+                   "samples": a.shards * a.rows_per_file,
+                   "resize_mechanism":
+                       "stop-resume generations under collective.launch "
+                       "(world stays 1 on a single chip; world-varying "
+                       "half proven by test_imagenet_multipod.py on a "
+                       "CPU world)"},
+        "wall_s": round(time.time() - t0, 1),
+    }
+    with open(a.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({k: report[k] for k in
+                      ("straight_acc1", "resized_acc1", "delta", "pass")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
