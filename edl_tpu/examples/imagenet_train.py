@@ -181,6 +181,14 @@ def main(argv=None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
+    if args.data_format == "jpeg" and args.synthetic_label_noise > 0:
+        # validate flag combinations BEFORE any rank-dependent code: a
+        # rank-0-only exit would strand the other ranks in the data-gen
+        # barrier below
+        raise SystemExit(
+            "--synthetic-label-noise is only implemented for the npz "
+            "synthetic generator (jpeg synthetic data is random-labeled "
+            "noise already)")
     if 0 < args.schedule_epochs < args.epochs:
         raise SystemExit(
             f"--schedule-epochs {args.schedule_epochs} < --epochs "
@@ -193,11 +201,6 @@ def main(argv=None) -> int:
     rank = max(0, env.rank)
     if args.make_synthetic and rank == 0:
         if args.data_format == "jpeg":
-            if args.synthetic_label_noise > 0:
-                raise SystemExit(
-                    "--synthetic-label-noise is only implemented for the "
-                    "npz synthetic generator (jpeg synthetic data is "
-                    "random-labeled noise already)")
             from edl_tpu.data.image import make_synthetic_jpeg_dataset
             make_synthetic_jpeg_dataset(
                 args.data_dir, args.make_synthetic,

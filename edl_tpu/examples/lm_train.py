@@ -79,6 +79,12 @@ def main(argv=None) -> int:
     parser.add_argument("--lr", type=float, default=3e-4)
     parser.add_argument("--warmup-steps", type=int, default=100)
     parser.add_argument("--bf16", action="store_true")
+    parser.add_argument("--fp16", action="store_true",
+                        help="float16 activations + dynamic loss scaling "
+                             "(train/amp.py; the reference's --fp16/"
+                             "--scale_loss). bf16 is the TPU-native "
+                             "choice — this exists for parity and "
+                             "fp16 experiments")
     parser.add_argument("--fused-loss", action="store_true",
                         help="streamed-vocab CE: never materializes the "
                              "(B,S,V) logits (ops/fused_xent.py) — use "
@@ -97,6 +103,8 @@ def main(argv=None) -> int:
                         help="jax profiler trace dir (steps 10-15, rank 0)")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
+    if args.fp16 and args.bf16:
+        parser.error("--fp16 and --bf16 are mutually exclusive")
 
     if 0 < args.schedule_epochs < args.epochs:
         raise SystemExit(
@@ -146,7 +154,8 @@ def main(argv=None) -> int:
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,
-        dtype=jnp.bfloat16 if args.bf16 else jnp.float32, mesh=mesh)
+        dtype=(jnp.float16 if args.fp16
+               else jnp.bfloat16 if args.bf16 else jnp.float32), mesh=mesh)
     model = Transformer(cfg)
 
     source = FileSource(files)
@@ -167,8 +176,22 @@ def main(argv=None) -> int:
                            train=False), mesh)
     state = TrainState.create(apply_fn=model.apply,
                               params=variables["params"], tx=tx)
-    step = make_train_step(lm_loss_fused if args.fused_loss else lm_loss_fn,
-                           donate=True)
+    loss = lm_loss_fused if args.fused_loss else lm_loss_fn
+    if args.fp16:
+        # TrainLoop's contract is step(state, batch); the loss-scale
+        # state rides a closure cell. It is NOT checkpointed — after an
+        # elastic restart the scale re-warms from init, costing at most
+        # a few skipped steps (the reference's decorate() state is
+        # likewise process-local).
+        from edl_tpu.train.amp import DynamicLossScale
+        raw_step = make_train_step(loss, donate=True, loss_scale=True)
+        ls_box = [DynamicLossScale.create()]
+
+        def step(state, batch):
+            state, metrics, ls_box[0] = raw_step(state, batch, ls_box[0])
+            return state, metrics
+    else:
+        step = make_train_step(loss, donate=True)
     log.info("world=%d rank=%d devices=%d params=%s steps/epoch=%d",
              world, rank, jax.device_count(),
              sum(p.size for p in jax.tree.leaves(state.params)),

@@ -1,0 +1,91 @@
+"""Dynamic loss scaling — the reference's fp16 mixed-precision knob.
+
+Capability of train_with_fleet.py:68-72,318-321 (`--fp16`,
+`--scale_loss`, Paddle's `decorate(..., use_dynamic_loss_scaling=True)`):
+scale the loss before the backward so fp16 gradients don't underflow,
+unscale before the update, SKIP the step when any gradient is non-finite
+(halving the scale), and grow the scale after a run of clean steps.
+
+On TPU the native story is bf16 (same exponent range as fp32 — no
+scaling needed), which is why the trainers default to bf16 and the
+transform lives off the hot path. It exists for capability parity and
+for fp16-activation experiments; it is jit-safe (the skip is a
+`tree_map(where(...))`, not Python control flow). Use through
+`make_train_step(loss_fn, loss_scale=True)`, whose step signature
+becomes `step(state, batch, ls) -> (state, metrics, ls)` with `ls`
+built ONCE via `DynamicLossScale.create()` (the bare NamedTuple
+constructor leaves scale=None) and threaded through every call
+(`lm_train --fp16` shows the TrainLoop closure-cell pattern).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class DynamicLossScale(NamedTuple):
+    """Loss-scale state. Defaults match the reference's Paddle decorate
+    defaults (init 2^15, 2x growth every 2000 clean steps, 0.5x on
+    overflow) within the usual AMP conventions."""
+
+    scale: jnp.ndarray = None  # type: ignore[assignment]
+    growth_count: jnp.ndarray = None  # type: ignore[assignment]
+    growth_interval: int = 2000
+
+    @staticmethod
+    def create(init_scale: float = 2.0 ** 15,
+               growth_interval: int = 2000) -> "DynamicLossScale":
+        return DynamicLossScale(
+            scale=jnp.float32(init_scale),
+            growth_count=jnp.int32(0),
+            growth_interval=growth_interval)
+
+
+def all_finite(tree) -> jax.Array:
+    leaves = [jnp.all(jnp.isfinite(leaf)) for leaf in jax.tree.leaves(tree)]
+    return jnp.stack(leaves).all() if leaves else jnp.bool_(True)
+
+
+def scaled_value_and_grad(loss_fn, params, ls: DynamicLossScale):
+    """value_and_grad of `ls.scale * loss`, with grads unscaled back.
+
+    loss_fn: params -> (loss, aux). Returns ((loss, aux), grads) where
+    grads may be non-finite — feed them to `update_scale_and_select`.
+    """
+
+    def scaled(p):
+        loss, aux = loss_fn(p)
+        return loss * ls.scale.astype(loss.dtype), (loss, aux)
+
+    (_, (loss, aux)), grads = jax.value_and_grad(
+        scaled, has_aux=True)(params)
+    inv = (1.0 / ls.scale).astype(jnp.float32)
+    grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+    return (loss, aux), grads
+
+
+def update_scale_and_select(ls: DynamicLossScale, grads, new_tree,
+                            old_tree):
+    """One AMP bookkeeping step, jit-safe.
+
+    Returns (new_ls, selected_tree, finite): on non-finite grads the
+    scale halves (floor 1.0) and `old_tree` is kept (the skipped step);
+    otherwise the growth counter advances, doubling the scale every
+    `growth_interval` clean steps (cap 2^24), and `new_tree` is taken.
+    """
+    finite = all_finite(grads)
+    count = jnp.where(finite, ls.growth_count + 1, 0)
+    grow = finite & (count >= ls.growth_interval)
+    scale = jnp.where(
+        finite,
+        jnp.where(grow, jnp.minimum(ls.scale * 2.0, 2.0 ** 24), ls.scale),
+        jnp.maximum(ls.scale * 0.5, 1.0))
+    count = jnp.where(grow, 0, count)
+    selected = jax.tree.map(
+        lambda new, old: jnp.where(finite, new, old), new_tree, old_tree)
+    return (DynamicLossScale(scale=scale, growth_count=count,
+                             growth_interval=ls.growth_interval),
+            selected, finite)

@@ -16,12 +16,44 @@ import jax
 LossFn = Callable[..., tuple[jax.Array, dict]]
 
 
-def make_train_step(loss_fn: LossFn, donate: bool = True) -> Callable:
+def make_train_step(loss_fn: LossFn, donate: bool = True,
+                    loss_scale: bool = False) -> Callable:
     """Build a jitted step from loss_fn(state, params, batch)->(loss, aux).
 
     If the model has batch_stats (BN), loss_fn should return aux containing
     'batch_stats' with the new stats; they are folded into the state.
+
+    `loss_scale=True` wraps the backward in dynamic loss scaling
+    (train/amp.py — the reference's fp16 `--scale_loss` capability,
+    train_with_fleet.py:68-72,318-321): the step signature becomes
+    `step(state, batch, ls) -> (state, metrics, ls)` and metrics gain
+    'loss_scale'/'finite'. Unneeded for bf16 (the TPU default).
     """
+    def apply(state, grads, aux):
+        """Fold optional BN stats + apply the update (shared by both
+        branches so the batch_stats contract lives in one place)."""
+        new_stats = aux.pop("batch_stats", None)
+        if new_stats is not None:
+            return state.apply_gradients(grads=grads,
+                                         batch_stats=new_stats)
+        return state.apply_gradients(grads=grads)
+
+    if loss_scale:
+        from edl_tpu.train import amp
+
+        def amp_step(state, batch, ls):
+            def compute(params):
+                return loss_fn(state, params, batch)
+
+            (loss, aux), grads = amp.scaled_value_and_grad(
+                compute, state.params, ls)
+            new_state = apply(state, grads, aux)
+            ls, selected, finite = amp.update_scale_and_select(
+                ls, grads, new_state, state)
+            return selected, {"loss": loss, "loss_scale": ls.scale,
+                              "finite": finite, **aux}, ls
+
+        return jax.jit(amp_step, donate_argnums=(0,) if donate else ())
 
     def step(state, batch):
         def compute(params):
@@ -29,11 +61,7 @@ def make_train_step(loss_fn: LossFn, donate: bool = True) -> Callable:
 
         (loss, aux), grads = jax.value_and_grad(compute, has_aux=True)(
             state.params)
-        new_stats = aux.pop("batch_stats", None)
-        if new_stats is not None:
-            state = state.apply_gradients(grads=grads, batch_stats=new_stats)
-        else:
-            state = state.apply_gradients(grads=grads)
+        state = apply(state, grads, aux)
         return state, {"loss": loss, **aux}
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,107 @@
+"""Dynamic loss scaling (train/amp.py) — the reference's fp16 knob."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from edl_tpu.train.amp import (DynamicLossScale, all_finite,
+                               scaled_value_and_grad,
+                               update_scale_and_select)
+from edl_tpu.train.state import TrainState
+from edl_tpu.train.step import make_train_step
+
+
+def _state(w=1.0):
+    return TrainState.create(
+        apply_fn=None,
+        params={"w": jnp.float32(w)},
+        tx=optax.sgd(0.1))
+
+
+class TestPrimitives:
+    def test_all_finite(self):
+        assert bool(all_finite({"a": jnp.ones(3)}))
+        assert not bool(all_finite({"a": jnp.array([1.0, jnp.inf])}))
+        assert not bool(all_finite({"a": jnp.array([jnp.nan])}))
+
+    def test_grads_unscaled_back(self):
+        ls = DynamicLossScale.create(init_scale=1024.0)
+
+        def loss(p):
+            return (p["w"] ** 2, {})
+
+        (loss_val, _), grads = scaled_value_and_grad(
+            loss, {"w": jnp.float32(3.0)}, ls)
+        assert float(loss_val) == 9.0  # reported loss is UNscaled
+        np.testing.assert_allclose(float(grads["w"]), 6.0, rtol=1e-6)
+
+    def test_overflow_halves_and_keeps_old(self):
+        ls = DynamicLossScale.create(init_scale=8.0)
+        bad = {"w": jnp.float32(jnp.nan)}
+        new, old = {"w": jnp.float32(2.0)}, {"w": jnp.float32(1.0)}
+        ls2, sel, finite = update_scale_and_select(ls, bad, new, old)
+        assert not bool(finite)
+        assert float(ls2.scale) == 4.0
+        assert float(sel["w"]) == 1.0  # step skipped
+        assert int(ls2.growth_count) == 0
+
+    def test_growth_after_interval(self):
+        ls = DynamicLossScale(scale=jnp.float32(8.0),
+                              growth_count=jnp.int32(1),
+                              growth_interval=2)
+        good = {"w": jnp.float32(1.0)}
+        ls2, sel, finite = update_scale_and_select(
+            ls, good, {"w": jnp.float32(2.0)}, {"w": jnp.float32(1.0)})
+        assert bool(finite)
+        assert float(ls2.scale) == 16.0  # grew at the interval
+        assert int(ls2.growth_count) == 0
+        assert float(sel["w"]) == 2.0
+
+    def test_scale_floor_and_cap(self):
+        low = DynamicLossScale(scale=jnp.float32(1.0),
+                               growth_count=jnp.int32(0),
+                               growth_interval=2000)
+        ls2, _, _ = update_scale_and_select(
+            low, {"w": jnp.float32(jnp.inf)},
+            {"w": jnp.float32(0.0)}, {"w": jnp.float32(0.0)})
+        assert float(ls2.scale) == 1.0  # floor
+        high = DynamicLossScale(scale=jnp.float32(2.0 ** 24),
+                                growth_count=jnp.int32(10),
+                                growth_interval=1)
+        ls3, _, _ = update_scale_and_select(
+            high, {"w": jnp.float32(1.0)},
+            {"w": jnp.float32(0.0)}, {"w": jnp.float32(0.0)})
+        assert float(ls3.scale) == 2.0 ** 24  # cap
+
+
+class TestAmpTrainStep:
+    def test_trains_like_unscaled(self):
+        def loss_fn(state, params, batch):
+            pred = params["w"] * batch["x"]
+            return jnp.mean((pred - batch["y"]) ** 2), {}
+
+        batch = {"x": jnp.arange(1.0, 5.0), "y": 3.0 * jnp.arange(1.0, 5.0)}
+        plain = make_train_step(loss_fn, donate=False)
+        amp = make_train_step(loss_fn, donate=False, loss_scale=True)
+        s_plain, s_amp = _state(0.0), _state(0.0)
+        ls = DynamicLossScale.create()
+        for _ in range(10):
+            s_plain, m_plain = plain(s_plain, batch)
+            s_amp, m_amp, ls = amp(s_amp, batch, ls)
+            assert bool(m_amp["finite"])
+        np.testing.assert_allclose(float(s_amp.params["w"]),
+                                   float(s_plain.params["w"]), rtol=1e-5)
+
+    def test_overflow_step_skipped_in_train_step(self):
+        def loss_fn(state, params, batch):
+            # overflow when scale is huge and loss moderate: force a nan
+            return params["w"] * jnp.float32(jnp.inf), {}
+
+        amp = make_train_step(loss_fn, donate=False, loss_scale=True)
+        state = _state(1.0)
+        ls = DynamicLossScale.create(init_scale=2.0 ** 15)
+        state2, m, ls2 = amp(state, {"x": jnp.zeros(1)}, ls)
+        assert not bool(m["finite"])
+        assert float(state2.params["w"]) == 1.0  # unchanged
+        assert float(ls2.scale) == 2.0 ** 14

@@ -167,12 +167,14 @@ def main(argv=None) -> int:
         deadline = time.time() + 20
         while True:  # poll readiness (a bare sleep races slow startups)
             try:
-                StoreClient(f"127.0.0.1:{port}").ping()
-                break
+                # ping() returns False (no raise) on a dead endpoint
+                if StoreClient(f"127.0.0.1:{port}").ping():
+                    break
             except Exception:
-                if time.time() > deadline:
-                    raise SystemExit("store server did not come up")
-                time.sleep(0.25)
+                pass
+            if time.time() > deadline:
+                raise SystemExit("store server did not come up")
+            time.sleep(0.25)
         ckpt = os.path.join(work, "ckpt")
         mid1 = max(1, a.epochs // 2)
         mid2 = max(mid1 + 1, a.epochs - 1)
@@ -200,13 +202,17 @@ def main(argv=None) -> int:
     # restore bug that re-memorizes still matches); require the straight
     # run to land BELOW the ceiling so the delta is discriminating.
     saturated = acc_s >= 1.0
+    ceiling = 1.0 - a.label_noise
+    # "learned the task" scales with the configured ceiling, not a fixed
+    # 0.8 (at --label-noise 0.25 a perfect run tops out at 0.75)
+    learned = acc_s > 0.85 * ceiling
     report = {
         "clause": "ResNet50_vd 224px, >=2 resize events, <1% acc1 loss",
         "straight_acc1": acc_s,
         "resized_acc1": acc_r,
         "delta": round(abs(acc_s - acc_r), 5),
         "saturated": saturated,
-        "pass": (abs(acc_s - acc_r) < 0.01 and acc_s > 0.8
+        "pass": (abs(acc_s - acc_r) < 0.01 and learned
                  and not saturated),
         "phases": phases,
         "straight": straight["final"],
@@ -214,7 +220,7 @@ def main(argv=None) -> int:
                    "classes": a.classes, "batch_size": a.batch_size,
                    "epochs": a.epochs, "lr": a.lr,
                    "label_noise": a.label_noise,
-                   "val_acc_ceiling": round(1.0 - a.label_noise, 4),
+                   "val_acc_ceiling": round(ceiling, 4),
                    "samples": a.shards * a.rows_per_file,
                    "resize_mechanism":
                        "stop-resume generations under collective.launch "
