@@ -105,6 +105,17 @@ def random_flip_lr_sample(img: np.ndarray, rng: np.random.Generator
     return img[:, ::-1] if rng.random() < 0.5 else img
 
 
+def random_rotate(img: np.ndarray, rng: np.random.Generator,
+                  max_deg: float = 10.0) -> np.ndarray:
+    """Rotate about the center by U(-max_deg, max_deg)
+    (img_tool.py:24-31 `rotate_image`, the reference's --rotate flag)."""
+    _require_cv2()
+    h, w = img.shape[:2]
+    angle = float(rng.uniform(-max_deg, max_deg))
+    m = cv2.getRotationMatrix2D((w / 2, h / 2), angle, 1.0)
+    return cv2.warpAffine(img, m, (w, h))
+
+
 def resize_short(img: np.ndarray, target: int) -> np.ndarray:
     """Scale so the SHORT side equals target (img_tool.py:77-86)."""
     _require_cv2()
@@ -126,8 +137,11 @@ def center_crop(img: np.ndarray, size: int) -> np.ndarray:
 def train_image_transform(size: int = 224,
                           scale: tuple[float, float] = (0.08, 1.0),
                           ratio: tuple[float, float] = (3 / 4, 4 / 3),
+                          rotate: bool = False,
                           key: str = "jpeg", out: str = "image"):
-    """Per-sample train path: decode -> random-resized-crop -> flip.
+    """Per-sample train path: decode -> [rotate] -> random-resized-crop
+    -> flip (the order of process_image, img_tool.py:119-131; rotate is
+    the reference's off-by-default --rotate flag).
 
     Returns a `(sample, rng) -> sample` callable for
     `DataLoader(sample_transforms=...)`. Output is uint8 (size, size, 3)
@@ -135,6 +149,8 @@ def train_image_transform(size: int = 224,
 
     def transform(sample: dict, rng: np.random.Generator) -> dict:
         img = decode_jpeg(sample[key])
+        if rotate:
+            img = random_rotate(img, rng)
         img = random_resized_crop(img, rng, size, scale, ratio)
         img = random_flip_lr_sample(img, rng)
         rest = {k: v for k, v in sample.items() if k != key}

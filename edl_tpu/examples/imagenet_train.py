@@ -172,6 +172,9 @@ def main(argv=None) -> int:
     parser.add_argument("--no-augment", action="store_true",
                         help="disable flip/crop transforms (synthetic-label "
                              "tasks are not augmentation-invariant)")
+    parser.add_argument("--rotate", action="store_true",
+                        help="jpeg mode: +-10 degree random rotation before "
+                             "the crop (reference --rotate, img_tool.py)")
     parser.add_argument("--ckpt-dir", default="")
     parser.add_argument("--benchmark-log", default="")
     parser.add_argument("--profile", default="",
@@ -181,6 +184,9 @@ def main(argv=None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
+    if args.rotate and (args.data_format != "jpeg" or args.no_augment):
+        raise SystemExit("--rotate is a jpeg-mode augmentation (and is "
+                         "incompatible with --no-augment)")
     if args.data_format == "jpeg" and args.synthetic_label_noise > 0:
         # validate flag combinations BEFORE any rank-dependent code: a
         # rank-0-only exit would strand the other ranks in the data-gen
@@ -238,7 +244,8 @@ def main(argv=None) -> int:
         sample_t = (eval_image_transform(
                         args.image_size, short=args.image_size * 8 // 7)
                     if args.no_augment
-                    else train_image_transform(args.image_size))
+                    else train_image_transform(args.image_size,
+                                               rotate=args.rotate))
         loader = DataLoader(source, local_bs, rank=rank, world=world,
                             seed=args.seed, sample_transforms=(sample_t,),
                             decode_threads=args.decode_threads)

@@ -67,6 +67,28 @@ class TestTransforms:
         c = center_crop(r, 50)
         assert c.shape == (50, 50, 3)
 
+    def test_random_rotate_deterministic_and_shaped(self):
+        from edl_tpu.data.image import random_rotate
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 256, (50, 70, 3), dtype=np.uint8)
+        a = random_rotate(img, np.random.default_rng(3))
+        b = random_rotate(img, np.random.default_rng(3))
+        c = random_rotate(img, np.random.default_rng(4))
+        assert a.shape == img.shape
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+
+    def test_train_transform_with_rotate(self):
+        rng = np.random.default_rng(0)
+        img = rng.integers(0, 256, (60, 80, 3), dtype=np.uint8)
+        s = {"jpeg": encode_jpeg(img), "label": np.int32(1)}
+        t = train_image_transform(32, rotate=True)
+        out = t(dict(s), np.random.default_rng(5))
+        assert out["image"].shape == (32, 32, 3)
+        # rotate changes the stream vs the rotate-free transform
+        out2 = train_image_transform(32)(dict(s), np.random.default_rng(5))
+        assert not np.array_equal(out["image"], out2["image"])
+
     def test_eval_transform_is_deterministic(self):
         rng = np.random.default_rng(0)
         img = rng.integers(0, 256, (70, 90, 3), dtype=np.uint8)

@@ -203,9 +203,10 @@ def main(argv=None) -> int:
     # run to land BELOW the ceiling so the delta is discriminating.
     saturated = acc_s >= 1.0
     ceiling = 1.0 - a.label_noise
-    # "learned the task" scales with the configured ceiling, not a fixed
-    # 0.8 (at --label-noise 0.25 a perfect run tops out at 0.75)
-    learned = acc_s > 0.85 * ceiling
+    # "learned the task" scales the original 0.8 bar by the configured
+    # ceiling (at --label-noise 0.25 a perfect run tops out at 0.75, so
+    # a fixed 0.8 would fail perfect runs; at noise 0 this stays 0.8)
+    learned = acc_s > 0.8 * ceiling
     report = {
         "clause": "ResNet50_vd 224px, >=2 resize events, <1% acc1 loss",
         "straight_acc1": acc_s,
