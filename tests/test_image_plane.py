@@ -174,6 +174,78 @@ class TestLoaderIntegration:
             DataLoader(src, 2, sample_transforms=(lambda s, r: s,))
 
 
+class TestMidEpochResumeOverDecodePool:
+    def test_interrupted_run_matches_straight(self, jpeg_dir, tmp_path):
+        """Mid-epoch stop-resume over the POOLED jpeg plane reproduces
+        the uninterrupted run's parameters exactly: the skip path
+        re-generates (and re-seeds) the same per-sample augmentation
+        stream, so a crash between step checkpoints loses nothing."""
+        import jax.numpy as jnp
+        import optax
+
+        from edl_tpu.parallel.mesh import MeshSpec, make_mesh
+        from edl_tpu.train.loop import LoopConfig, TrainLoop
+        from edl_tpu.train.state import TrainState
+        from edl_tpu.train.step import make_train_step
+
+        root, list_file = jpeg_dir
+        mesh = make_mesh(MeshSpec({"dp": -1}))  # all virtual devices
+
+        def build():
+            import jax
+
+            def loss_fn(state, params, batch):
+                img = batch["image"].astype(jnp.float32) / 255.0
+                pred = jnp.mean(img, axis=(1, 2)) @ params["w"]
+                tgt = jax.nn.one_hot(batch["label"], 5)
+                return jnp.mean((pred - tgt) ** 2), {}
+
+            params = {"w": jnp.zeros((3, 5), jnp.float32)}
+            state = TrainState.create(apply_fn=None, params=params,
+                                      tx=optax.sgd(0.5))
+            return state, make_train_step(loss_fn, donate=False)
+
+        src = JpegFileListSource(list_file, root=root)
+        data = DataLoader(src, 8, seed=3,
+                          sample_transforms=(train_image_transform(16),),
+                          decode_threads=2)  # __call__(epoch) = data_fn
+
+        # straight: 2 epochs, no interruption
+        state, step = build()
+        straight = TrainLoop(step, state, mesh=mesh,
+                             config=LoopConfig(num_epochs=2))
+        straight.run(data)
+
+        # interrupted: crash mid-epoch-0 after the step-2 checkpoint
+        class Crash(Exception):
+            pass
+
+        def crashing(epoch):
+            for i, b in enumerate(data(epoch)):
+                if i == 2:
+                    raise Crash()
+                yield b
+
+        state2, step2 = build()
+        run1 = TrainLoop(step2, state2, mesh=mesh,
+                         config=LoopConfig(num_epochs=2,
+                                           ckpt_dir=str(tmp_path / "ck"),
+                                           ckpt_every_steps=2))
+        with pytest.raises(Crash):
+            run1.run(crashing)
+        state3, step3 = build()
+        run2 = TrainLoop(step3, state3, mesh=mesh,
+                         config=LoopConfig(num_epochs=2,
+                                           ckpt_dir=str(tmp_path / "ck"),
+                                           ckpt_every_steps=2))
+        run2.run(data)
+        data.close()
+
+        np.testing.assert_allclose(
+            np.asarray(run2.state.params["w"]),
+            np.asarray(straight.state.params["w"]), rtol=1e-6)
+
+
 class TestFlagshipJpegMode:
     def test_imagenet_train_jpeg_end_to_end(self, tmp_path):
         """The flagship trainer over the JPEG plane: synthetic JPEGs +
