@@ -1,7 +1,9 @@
 from edl_tpu.coord.store import InMemStore, Record, Event, Store
 from edl_tpu.coord.client import StoreClient
 from edl_tpu.coord.lock import DistributedLock, LeaderElection
+from edl_tpu.coord.redis_store import RedisStore, connect_store
 from edl_tpu.coord.registry import ServiceRegistry, ServerMeta
+from edl_tpu.coord.resp import MiniRedis
 from edl_tpu.coord.consistent_hash import ConsistentHash
 
 
@@ -20,6 +22,9 @@ __all__ = [
     "Event",
     "StoreClient",
     "StoreServer",
+    "RedisStore",
+    "MiniRedis",
+    "connect_store",
     "DistributedLock",
     "LeaderElection",
     "ServiceRegistry",

@@ -1,0 +1,257 @@
+"""Redis-backed Store: the reference's second discovery flavor (C10-C14).
+
+The reference duplicated its whole distill discovery stack over redis
+(`python/paddle_edl/distill/redis/` — registry on TTL'd hashes
+`/service/{name}/nodes/{server}`, redis_store.py:38-53, plus its own
+balance server and registrar). Here the stack is already generic over
+the `Store` interface, so the flavor is ONE class: `RedisStore` speaks
+RESP2 (coord/resp.py) to a real redis — or the bundled `MiniRedis` —
+and `ServiceRegistry`/`TeacherRegistrar`/`DiscoveryServer`/
+`DistillReader` run over it unchanged. Select it anywhere a store
+endpoint is accepted with a `redis://host:port` URI (`connect_store`).
+
+Mapping:
+- records live at their key as JSON ``{"v": value, "r": revision}``;
+  revisions come from ``INCR !edl:rev`` so `get_prefix` stays
+  monotonic (redis has no native revisions);
+- a lease is ``!edl:lease:{id}`` (PEXPIRE'd) + a member set
+  ``!edl:lease:{id}:k``; a key bound to the lease is written with
+  ``SET ... PX ttl`` in ONE command (no TTL-less window a crash could
+  leave behind), keepalive re-arms everything, revoke deletes — the
+  TTL-key semantics the reference's registrar heartbeat relies on.
+  The lease is validated BEFORE the key is written: a put against an
+  expired lease must not resurrect the key (a dead teacher would stay
+  routable forever);
+- prefix reads use SCAN (cursor loop), not KEYS — the discovery server
+  polls every tick and KEYS blocks a production redis on the whole
+  keyspace;
+- scope matches the reference's: the redis flavor serves the
+  DISCOVERY/DISTILL pillar. `compare_and_swap` is GET-compare-SET —
+  correct only for single-writer keys (a Registration reclaiming its
+  own key), which is all the discovery stack needs; CONTENDED cas
+  (DistributedLock, task master, rank claims) and event watches stay
+  on the edl store, exactly as the reference kept its master on etcd.
+  Out-of-scope methods raise EdlRedisError — a subclass of
+  EdlStoreError, so the registry's bounded-retry paths treat it as a
+  store failure rather than dying.
+"""
+
+from __future__ import annotations
+
+import json
+
+from edl_tpu.coord.resp import RespClient
+from edl_tpu.coord.store import Record, Store
+from edl_tpu.utils.exceptions import EdlStoreError
+
+
+class EdlRedisError(EdlStoreError):
+    pass
+
+
+_REV = "!edl:rev"
+_LEASE_ID = "!edl:lease:id"
+
+
+def _lease_key(lease: int) -> str:
+    return f"!edl:lease:{lease}"
+
+
+def _glob_escape(s: str) -> str:
+    out = []
+    for ch in s:
+        if ch in "*?[]\\":
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+class RedisStore(Store):
+    """Store subset over RESP (see module docstring for the mapping)."""
+
+    def __init__(self, endpoint: str, timeout: float = 10.0):
+        self._client = RespClient(endpoint, timeout=timeout)
+
+    def close(self) -> None:
+        self._client.close()
+
+    def ping(self) -> bool:
+        try:
+            return self._client.command("PING") == "PONG"
+        except Exception:  # noqa: BLE001 — liveness probe
+            return False
+
+    # -- kv ----------------------------------------------------------------
+
+    def _bump(self) -> int:
+        return int(self._client.command("INCR", _REV))
+
+    def _lease_ttl_ms(self, lease: int) -> int:
+        """The live lease's ttl; raises if it expired (validated BEFORE
+        any key write — see module docstring)."""
+        blob = self._client.command("GET", _lease_key(lease))
+        if blob is None:
+            from edl_tpu.utils.exceptions import EdlLeaseExpired
+            raise EdlLeaseExpired(f"lease {lease} unknown or expired")
+        return int(json.loads(blob)["ttl_ms"])
+
+    def _detach(self, key: str, old_blob: str | None,
+                new_lease: int) -> None:
+        """SREM the key from a previous lease's member set when the
+        binding changes — otherwise a stale lease's keepalive keeps
+        re-arming (and its revoke deletes) a key it no longer owns
+        (InMemStore._detach's semantics)."""
+        rec = self._decode(key, old_blob)
+        if rec is not None and rec.lease and rec.lease != new_lease:
+            self._client.command("SREM", _lease_key(rec.lease) + ":k", key)
+
+    def _set(self, key: str, value: str, lease: int,
+             nx: bool) -> tuple[bool, int]:
+        rev = self._bump()
+        blob = json.dumps({"v": value, "r": rev, "l": lease})
+        args = ["SET", key, blob]
+        ttl_ms = 0
+        if lease:
+            ttl_ms = self._lease_ttl_ms(lease)  # validate first
+            args += ["PX", str(ttl_ms)]  # atomic value+TTL
+        if nx:
+            args.append("NX")
+        old = None if nx else self._client.command("GET", key)
+        ok = self._client.command(*args)
+        if ok is None:
+            return False, rev
+        self._detach(key, old, lease)
+        if lease:
+            members = _lease_key(lease) + ":k"
+            self._client.command("SADD", members, key)
+            self._client.command("PEXPIRE", members, ttl_ms)
+        return True, rev
+
+    def put(self, key: str, value: str, lease: int = 0) -> int:
+        return self._set(key, value, lease, nx=False)[1]
+
+    def put_if_absent(self, key: str, value: str, lease: int = 0) -> bool:
+        return self._set(key, value, lease, nx=True)[0]
+
+    def _decode(self, key: str, blob: str | None) -> Record | None:
+        if blob is None:
+            return None
+        try:
+            doc = json.loads(blob)
+            return Record(key=key, value=doc["v"], revision=int(doc["r"]),
+                          lease=int(doc.get("l", 0)))
+        except (json.JSONDecodeError, KeyError, ValueError):
+            return None
+
+    def get(self, key: str) -> Record | None:
+        return self._decode(key, self._client.command("GET", key))
+
+    def _scan(self, pattern: str) -> list[str]:
+        """Cursor-looped SCAN (KEYS blocks a production redis on the
+        whole keyspace; the discovery server polls every tick)."""
+        keys, cursor = [], "0"
+        while True:
+            reply = self._client.command("SCAN", cursor, "MATCH", pattern,
+                                         "COUNT", "512")
+            cursor, batch = reply[0], reply[1] or []
+            keys.extend(batch)
+            if cursor == "0":
+                return keys
+
+    def get_prefix(self, prefix: str) -> tuple[list[Record], int]:
+        keys = self._scan(_glob_escape(prefix) + "*")
+        recs = []
+        if keys:
+            blobs = self._client.command("MGET", *keys)
+            for key, blob in zip(keys, blobs):
+                rec = self._decode(key, blob)
+                if rec is not None:
+                    recs.append(rec)
+        recs.sort(key=lambda r: r.key)
+        rev = int(self._client.command("GET", _REV) or 0)
+        return recs, rev
+
+    def delete(self, key: str) -> bool:
+        self._detach(key, self._client.command("GET", key), new_lease=0)
+        return int(self._client.command("DEL", key)) > 0
+
+    def delete_prefix(self, prefix: str) -> int:
+        keys = self._scan(_glob_escape(prefix) + "*")
+        if not keys:
+            return 0
+        for key, blob in zip(keys, self._client.command("MGET", *keys)):
+            self._detach(key, blob, new_lease=0)
+        return int(self._client.command("DEL", *keys))
+
+    # -- leases ------------------------------------------------------------
+
+    def lease_grant(self, ttl: float) -> int:
+        lease = int(self._client.command("INCR", _LEASE_ID))
+        ttl_ms = max(1, int(ttl * 1000))
+        self._client.command("SET", _lease_key(lease),
+                             json.dumps({"ttl_ms": ttl_ms}),
+                             "PX", str(ttl_ms))
+        return lease
+
+    def lease_keepalive(self, lease: int) -> bool:
+        blob = self._client.command("GET", _lease_key(lease))
+        if blob is None:
+            return False  # expired: the registrar re-registers
+        ttl_ms = int(json.loads(blob)["ttl_ms"])
+        self._client.command("PEXPIRE", _lease_key(lease), ttl_ms)
+        members = self._client.command(
+            "SMEMBERS", _lease_key(lease) + ":k") or []
+        self._client.command("PEXPIRE", _lease_key(lease) + ":k", ttl_ms)
+        for key in members:
+            self._client.command("PEXPIRE", key, ttl_ms)
+        return True
+
+    def lease_revoke(self, lease: int) -> bool:
+        members = self._client.command(
+            "SMEMBERS", _lease_key(lease) + ":k") or []
+        existed = self._client.command("GET", _lease_key(lease)) is not None
+        targets = list(members) + [_lease_key(lease),
+                                   _lease_key(lease) + ":k"]
+        self._client.command("DEL", *targets)
+        return existed
+
+    # -- cas: SINGLE-WRITER keys only ---------------------------------------
+
+    def compare_and_swap(self, key: str, expect: str | None, value: str,
+                         lease: int = 0) -> bool:
+        """GET-compare-SET, NOT atomic across writers.
+
+        Sufficient for the discovery pillar's use — `Registration`
+        reclaiming ITS OWN key after a lease lapse (registry.py:89),
+        where this registrant is the only writer of the key. CONTENDED
+        cas users (DistributedLock, task master, rank claims) must stay
+        on the edl store: two racing writers can both pass the compare
+        here. The reference drew the same line — its redis flavor
+        served discovery only, the master stayed on etcd.
+        """
+        cur = self._client.command("GET", key)
+        cur_value = None if cur is None else \
+            (self._decode(key, cur).value
+             if self._decode(key, cur) is not None else None)
+        if cur_value != expect:
+            return False
+        if expect is None:
+            return self.put_if_absent(key, value, lease)
+        return self._set(key, value, lease, nx=False)[0]
+
+    # -- out of the redis flavor's scope ------------------------------------
+
+    def events_since(self, revision: int, prefix: str = ""):
+        raise EdlRedisError(
+            "event watches are not served by the redis flavor; watchers "
+            "over redis poll get_prefix (ServiceWatcher already does)")
+
+
+def connect_store(endpoint: str, timeout: float = 10.0) -> Store:
+    """Store from an endpoint string: `redis://host:port` -> RedisStore,
+    bare `host:port` -> the edl store client (the default)."""
+    if endpoint.startswith("redis://"):
+        return RedisStore(endpoint[len("redis://"):], timeout=timeout)
+    from edl_tpu.coord.client import StoreClient
+    return StoreClient(endpoint, timeout=timeout)

@@ -32,7 +32,7 @@ import threading
 import time
 
 from edl_tpu.coord import wire
-from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.redis_store import connect_store
 from edl_tpu.coord.consistent_hash import ConsistentHash
 from edl_tpu.coord.registry import Registration, ServiceRegistry
 from edl_tpu.coord.store import Store
@@ -282,7 +282,7 @@ def main(argv=None) -> int:
     parser.add_argument("--tick-interval", type=float, default=1.0)
     args = parser.parse_args(argv)
     server = DiscoveryServer(
-        StoreClient(args.store), port=args.port, host=args.host,
+        connect_store(args.store), port=args.port, host=args.host,
         advertise=args.advertise, root=args.root,
         client_ttl=args.client_ttl, tick_interval=args.tick_interval)
     server.start()

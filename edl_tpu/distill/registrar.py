@@ -25,7 +25,7 @@ import json
 import threading
 import time
 
-from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.redis_store import connect_store
 from edl_tpu.coord.registry import Registration, ServiceRegistry
 from edl_tpu.coord.store import Store
 from edl_tpu.utils import net
@@ -157,7 +157,7 @@ def main(argv=None) -> int:
                              "(0 disables)")
     args = parser.parse_args(argv)
     registrar = TeacherRegistrar(
-        StoreClient(args.store), args.service, args.server, info=args.info,
+        connect_store(args.store), args.service, args.server, info=args.info,
         ttl=args.ttl, root=args.root, probe_timeout=args.probe_timeout,
         stats_interval=args.stats_interval)
     registrar.start()

@@ -1,0 +1,279 @@
+"""Redis discovery flavor (coord/resp.py + coord/redis_store.py).
+
+The reference's second balancer flavor (C10-C14: redis TTL-hash registry
++ hand-rolled TCP server + registrar, `distill/redis/`) — here one Store
+backend that the existing discovery stack runs over unchanged. Mirrors
+the reference's test_redis_distill_reader.sh flow: registry + registrar
++ discovery server + DistillReader, all over the RESP store.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from edl_tpu.coord.redis_store import (EdlRedisError, RedisStore,
+                                       connect_store)
+from edl_tpu.coord.registry import ServiceRegistry
+from edl_tpu.coord.resp import MiniRedis, RespClient, RespError
+from edl_tpu.utils.exceptions import EdlLeaseExpired
+
+
+@pytest.fixture()
+def server():
+    srv = MiniRedis().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def store(server):
+    st = RedisStore(server.endpoint)
+    yield st
+    st.close()
+
+
+class TestRespWire:
+    def test_roundtrip_commands(self, server):
+        c = RespClient(server.endpoint)
+        assert c.command("PING") == "PONG"
+        assert c.command("SET", "a", "1") == "OK"
+        assert c.command("GET", "a") == "1"
+        assert c.command("GET", "missing") is None
+        assert c.command("INCR", "n") == 1
+        assert c.command("INCR", "n") == 2
+        assert c.command("DEL", "a", "n") == 2
+        c.close()
+
+    def test_set_nx(self, server):
+        c = RespClient(server.endpoint)
+        assert c.command("SET", "k", "v", "NX") == "OK"
+        assert c.command("SET", "k", "w", "NX") is None
+        assert c.command("GET", "k") == "v"
+        c.close()
+
+    def test_keys_glob_and_expiry(self, server):
+        c = RespClient(server.endpoint)
+        c.command("SET", "/svc/a", "1")
+        c.command("SET", "/svc/b", "2")
+        c.command("SET", "/other", "3")
+        assert c.command("KEYS", "/svc/*") == ["/svc/a", "/svc/b"]
+        assert c.command("PEXPIRE", "/svc/a", 30) == 1
+        time.sleep(0.08)
+        assert c.command("GET", "/svc/a") is None
+        assert c.command("KEYS", "/svc/*") == ["/svc/b"]
+        c.close()
+
+    def test_unknown_command_is_error(self, server):
+        c = RespClient(server.endpoint)
+        with pytest.raises(RespError):
+            c.command("WHATEVER")
+        # connection still usable after an -ERR reply
+        assert c.command("PING") == "PONG"
+        c.close()
+
+    def test_garbage_bytes_drop_connection_not_server(self, server):
+        import socket
+
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=2)
+        s.sendall(b"not resp at all\r\n")
+        s.close()
+        c = RespClient(server.endpoint)
+        assert c.command("PING") == "PONG"  # server survived
+        c.close()
+
+
+class TestRedisStore:
+    def test_put_get_revisions_monotonic(self, store):
+        r1 = store.put("/k1", "a")
+        r2 = store.put("/k2", "b")
+        assert r2 > r1
+        rec = store.get("/k1")
+        assert rec.value == "a" and rec.revision == r1
+        assert store.get("/nope") is None
+
+    def test_get_prefix_sorted_and_rev(self, store):
+        store.put("/p/b", "2")
+        store.put("/p/a", "1")
+        store.put("/q/x", "3")
+        recs, rev = store.get_prefix("/p/")
+        assert [r.key for r in recs] == ["/p/a", "/p/b"]
+        assert rev >= max(r.revision for r in recs)
+
+    def test_put_if_absent(self, store):
+        assert store.put_if_absent("/once", "first")
+        assert not store.put_if_absent("/once", "second")
+        assert store.get("/once").value == "first"
+
+    def test_delete_and_prefix(self, store):
+        store.put("/d/a", "1")
+        store.put("/d/b", "2")
+        assert store.delete("/d/a")
+        assert not store.delete("/d/a")
+        assert store.delete_prefix("/d/") == 1
+
+    def test_lease_expiry_removes_keys(self, store):
+        lease = store.lease_grant(0.08)
+        store.put("/leased", "v", lease=lease)
+        assert store.get("/leased") is not None
+        time.sleep(0.15)
+        assert store.get("/leased") is None
+        assert not store.lease_keepalive(lease)
+
+    def test_lease_keepalive_extends(self, store):
+        lease = store.lease_grant(0.15)
+        store.put("/ka", "v", lease=lease)
+        for _ in range(4):
+            time.sleep(0.07)
+            assert store.lease_keepalive(lease)
+        assert store.get("/ka") is not None  # outlived 2x its ttl
+
+    def test_lease_revoke_deletes(self, store):
+        lease = store.lease_grant(5.0)
+        store.put("/r1", "a", lease=lease)
+        store.put("/r2", "b", lease=lease)
+        assert store.lease_revoke(lease)
+        assert store.get("/r1") is None and store.get("/r2") is None
+
+    def test_put_with_dead_lease_raises_and_writes_nothing(self, store):
+        lease = store.lease_grant(0.05)
+        time.sleep(0.12)
+        with pytest.raises(EdlLeaseExpired):
+            store.put("/x", "v", lease=lease)
+        # the lease is validated BEFORE the SET: a dead teacher's key
+        # must not be resurrected TTL-less (it would stay routable
+        # forever)
+        assert store.get("/x") is None
+
+    def test_prefix_with_glob_chars_in_service_name(self, store):
+        # service names containing glob metacharacters must round-trip
+        # (escape semantics must agree between client and server)
+        store.put("/svc[1]/nodes/a", "1")
+        store.put("/svc[1]/nodes/b", "2")
+        recs, _ = store.get_prefix("/svc[1]/nodes/")
+        assert [r.key for r in recs] == ["/svc[1]/nodes/a",
+                                        "/svc[1]/nodes/b"]
+
+    def test_client_recovers_after_transport_error(self, server, store):
+        # sabotage the socket mid-stream, then verify the next command
+        # reconnects instead of reading a stale reply
+        store._client._sock.close()
+        assert store.ping()  # reconnected transparently
+        store.put("/after", "ok")
+        assert store.get("/after").value == "ok"
+
+    def test_cas_single_writer_semantics(self, store):
+        assert store.compare_and_swap("/c", None, "v1")  # absent -> set
+        assert not store.compare_and_swap("/c", "wrong", "v2")
+        assert store.compare_and_swap("/c", "v1", "v2")
+        assert store.get("/c").value == "v2"
+
+    def test_cas_rebinds_lease(self, store):
+        """The Registration owned-key reclaim path: cas with a fresh
+        lease after the old one lapsed — the key must carry the NEW
+        lease's ttl."""
+        l1 = store.lease_grant(0.1)
+        store.put("/own", "tok", lease=l1)
+        time.sleep(0.05)
+        l2 = store.lease_grant(0.5)
+        assert store.compare_and_swap("/own", "tok", "tok2", lease=l2)
+        time.sleep(0.2)  # old lease long dead; new one keeps it alive
+        assert store.lease_keepalive(l2)
+        assert store.get("/own").value == "tok2"
+
+    def test_overwrite_detaches_old_lease(self, store):
+        """Re-putting a key lease-less must detach it: the old lease's
+        expiry/revoke must no longer touch it (InMemStore semantics)."""
+        lease = store.lease_grant(0.2)
+        store.put("/det", "a", lease=lease)
+        store.put("/det", "b")  # now persistent
+        store.lease_revoke(lease)
+        assert store.get("/det").value == "b"  # revoke didn't delete it
+        time.sleep(0.3)
+        assert store.get("/det") is not None  # no stale TTL either
+
+    def test_events_since_out_of_scope(self, store):
+        with pytest.raises(EdlRedisError):
+            store.events_since(0)
+
+    def test_connect_store_scheme(self, server):
+        st = connect_store(f"redis://{server.endpoint}")
+        assert isinstance(st, RedisStore)
+        assert st.ping()
+        st.close()
+
+
+class TestRegistryOverRedis:
+    def test_register_heartbeat_expiry(self, store):
+        reg = ServiceRegistry(store, root="edl_distill")
+        registration = reg.register("svc", "10.0.0.1:9000",
+                                    info="{}", ttl=0.4)
+        try:
+            metas = reg.get_service("svc")
+            assert [m.server for m in metas] == ["10.0.0.1:9000"]
+            time.sleep(0.9)  # heartbeats must be keeping it alive
+            assert [m.server for m in reg.get_service("svc")] \
+                == ["10.0.0.1:9000"]
+        finally:
+            registration.stop()
+        deadline = time.time() + 3
+        while time.time() < deadline and reg.get_service("svc"):
+            time.sleep(0.05)
+        assert reg.get_service("svc") == []  # lease lapsed after stop
+
+    def test_update_info_visible(self, store):
+        reg = ServiceRegistry(store, root="edl_distill")
+        registration = reg.register("svc", "t:1", info="old", ttl=2.0)
+        try:
+            registration.update_info("new")
+            deadline = time.time() + 2
+            while time.time() < deadline:
+                metas = reg.get_service("svc")
+                if metas and metas[0].info == "new":
+                    break
+                time.sleep(0.05)
+            assert reg.get_service("svc")[0].info == "new"
+        finally:
+            registration.stop()
+
+
+def test_distill_stack_over_redis(server):
+    """The reference's test_redis_distill_reader flow: teachers register
+    in the redis registry, the discovery server balances them, a
+    DistillReader consumes through dynamic discovery — all over RESP."""
+    from edl_tpu.distill.discovery_server import DiscoveryServer
+    from edl_tpu.distill.reader import DistillReader
+    from edl_tpu.distill.registrar import TeacherRegistrar
+    from edl_tpu.distill.teacher_server import TeacherServer
+
+    def predict(feeds):
+        return {"logits": feeds["x"] * 2.0}
+
+    store = RedisStore(server.endpoint)
+    teacher = TeacherServer(predict, host="127.0.0.1").start()
+    endpoint = f"127.0.0.1:{teacher.port}"
+    registrar = TeacherRegistrar(store, "svc", endpoint, ttl=1.0,
+                                 probe_timeout=10.0, probe_interval=0.05)
+    registrar.start()
+    disco = DiscoveryServer(RedisStore(server.endpoint), port=0,
+                            host="127.0.0.1", tick_interval=0.1,
+                            client_ttl=10.0).start()
+    rng = np.random.default_rng(0)
+    batches = [{"x": rng.normal(size=(8, 3)).astype(np.float32)}
+               for _ in range(6)]
+    dr = DistillReader(lambda: iter(batches), feeds=["x"],
+                       predicts=["logits"], discovery=disco.endpoint,
+                       service="svc", teacher_batch_size=4,
+                       manage_interval=0.05)
+    try:
+        out = list(dr())
+        assert len(out) == 6
+        for got, fed in zip(out, batches):
+            np.testing.assert_allclose(got["logits"], fed["x"] * 2.0,
+                                       rtol=1e-6)
+    finally:
+        dr.close()
+        disco.stop()
+        registrar.stop()
+        teacher.stop()
+        store.close()
