@@ -88,13 +88,16 @@ class RedisStore(Store):
         return int(self._client.command("INCR", _REV))
 
     def _lease_ttl_ms(self, lease: int) -> int:
-        """The live lease's ttl; raises if it expired (validated BEFORE
-        any key write — see module docstring)."""
-        blob = self._client.command("GET", _lease_key(lease))
-        if blob is None:
+        """The live lease's REMAINING ttl (PTTL), so a key written late
+        in a lease window expires WITH the lease rather than up to one
+        full TTL after it — a dead teacher must not linger routable.
+        Raises if the lease expired (validated BEFORE any key write —
+        see module docstring)."""
+        remaining = int(self._client.command("PTTL", _lease_key(lease)))
+        if remaining < 0:  # -2 no key, -1 no TTL (never set by us)
             from edl_tpu.utils.exceptions import EdlLeaseExpired
             raise EdlLeaseExpired(f"lease {lease} unknown or expired")
-        return int(json.loads(blob)["ttl_ms"])
+        return max(1, remaining)
 
     def _detach(self, key: str, old_blob: str | None,
                 new_lease: int) -> None:

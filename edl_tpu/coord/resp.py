@@ -234,7 +234,7 @@ class _State:
         return key in self.strings or key in self.sets
 
     def _live_keys(self, pattern: str) -> list[str]:
-        keys = list(self.strings) + list(self.sets)
+        keys = set(self.strings) | set(self.sets)  # a key can be both
         return sorted(k for k in keys
                       if self._alive(k) and redis_glob_match(pattern, k))
 
@@ -255,8 +255,10 @@ class _State:
                         px_ms = int(opts[i + 1])
                         i += 1
                     i += 1
-                if nx and self._alive(key) and key in self.strings:
+                # real redis's NX is type-agnostic: any live key blocks
+                if nx and self._alive(key):
                     return None
+                self.sets.pop(key, None)  # SET replaces any type
                 self.strings[key] = val
                 if px_ms is not None:
                     self.deadlines[key] = time.monotonic() + px_ms / 1000.0

@@ -19,8 +19,11 @@ through HBM), which is what motivated the kernels.
 
 Layout contract: (B, S, H, D) in, (B, S, H, D) out (the transformer's
 native layout; the kernel grid works on (B*H, S, D) views). On non-TPU
-backends the kernel runs in Pallas interpret mode, so CPU tests exercise
-the same code path bit-for-bit.
+backends both directions dispatch to compiled XLA blockwise paths
+(`_fwd_blockwise` / `_bwd_blockwise`) — interpret-mode Pallas is orders
+of magnitude slower and would throttle the CPU elastic/multipod worlds.
+The parity tests force the kernels through the same public API via
+`force_interpret_kernels()`.
 
 No reference counterpart (its models are CNNs + served ERNIE); this is
 the tpu-first half of the long-context story, composing with
@@ -30,6 +33,7 @@ per-shard attention on each block pair.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
@@ -115,6 +119,45 @@ def _fwd(q, k, v, *, blk_q: int, blk_k: int, scale: float, causal: bool,
     )(qt, kt, vt)
     o = o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return o, lse[..., 0]
+
+
+def _fwd_blockwise(q, k, v, *, blk: int, scale: float, causal: bool):
+    """Flash forward in plain XLA (KV-block scan with the online
+    softmax) — the off-TPU fallback. Returns (o, lse) exactly as `_fwd`
+    does: o (B,S,H,D) in q.dtype, lse (B*H, S) fp32."""
+    b, s, h, d = q.shape
+    q32 = q.astype(jnp.float32)
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    q_pos = jnp.arange(s)
+
+    def kv_step(carry, ki):
+        m, l, acc = carry  # (B,H,S), (B,H,S), (B,S,H,D)
+        ksl = lax.dynamic_slice_in_dim(k32, ki * blk, blk, axis=1)
+        vsl = lax.dynamic_slice_in_dim(v32, ki * blk, blk, axis=1)
+        sblk = jnp.einsum("bqhd,bkhd->bhqk", q32, ksl,
+                          preferred_element_type=jnp.float32) * scale
+        if causal:
+            kv_pos = ki * blk + jnp.arange(blk)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            sblk = jnp.where(mask[None, None], sblk, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sblk, axis=-1))
+        p = jnp.exp(sblk - m_new[..., None])
+        corr = jnp.exp(m - m_new)  # (B,H,S)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = (acc * corr.transpose(0, 2, 1)[..., None]
+               + jnp.einsum("bhqk,bkhd->bqhd", p, vsl,
+                            preferred_element_type=jnp.float32))
+        return (m_new, l, acc), None
+
+    init = (jnp.full((b, h, s), _NEG_INF, jnp.float32),
+            jnp.zeros((b, h, s), jnp.float32),
+            jnp.zeros((b, s, h, d), jnp.float32))
+    (m, l, acc), _ = lax.scan(kv_step, init, jnp.arange(s // blk))
+    l = jnp.maximum(l, 1e-30)  # same guard as the kernel
+    o = (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    lse = (m + jnp.log(l)).reshape(b * h, s)
+    return o, lse
 
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, rt_ref,
@@ -341,28 +384,56 @@ def _fit_block(s: int, want: int) -> int:
                      f"<= {want} (pad the sequence to a multiple of 128)")
 
 
+_FORCE_INTERPRET = False
+
+
+@contextlib.contextmanager
+def force_interpret_kernels():
+    """Test hook: run the Pallas kernels (fwd AND bwd) in interpret mode
+    even off-TPU — the parity tests compare them against the XLA
+    blockwise paths through the public API."""
+    global _FORCE_INTERPRET
+    _FORCE_INTERPRET = True
+    try:
+        yield
+    finally:
+        _FORCE_INTERPRET = False
+
+
+def _use_kernels() -> bool:
+    """Off-TPU the compiled XLA blockwise paths run instead of
+    interpret-mode Pallas (orders of magnitude slower — it would
+    throttle the CPU elastic/multipod worlds)."""
+    return jax.default_backend() == "tpu" or _FORCE_INTERPRET
+
+
+def _fwd_dispatch(q, k, v, blk_q, blk_k, scale, causal):
+    if not _use_kernels():
+        return _fwd_blockwise(q, k, v, blk=blk_k, scale=scale,
+                              causal=causal)
+    return _fwd(q, k, v, blk_q=blk_q, blk_k=blk_k, scale=scale,
+                causal=causal, interpret=jax.default_backend() != "tpu")
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_lse(q, k, v, blk_q, blk_k, scale, causal):
-    interpret = jax.default_backend() != "tpu"
-    o, lse = _fwd(q, k, v, blk_q=blk_q, blk_k=blk_k, scale=scale,
-                  causal=causal, interpret=interpret)
-    return o, lse
+    return _fwd_dispatch(q, k, v, blk_q, blk_k, scale, causal)
 
 
 def _flash_lse_fwd(q, k, v, blk_q, blk_k, scale, causal):
-    interpret = jax.default_backend() != "tpu"
-    o, lse = _fwd(q, k, v, blk_q=blk_q, blk_k=blk_k, scale=scale,
-                  causal=causal, interpret=interpret)
+    o, lse = _fwd_dispatch(q, k, v, blk_q, blk_k, scale, causal)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_lse_bwd(blk_q, blk_k, scale, causal, res, cotangents):
     q, k, v, o, lse = res
     do, dlse = cotangents
-    interpret = jax.default_backend() != "tpu"
+    if not _use_kernels():
+        return _bwd_blockwise(q, k, v, o, lse, do, blk=blk_k,
+                              scale=scale, causal=causal, dlse=dlse)
     return _bwd_pallas(q, k, v, o, lse, do, blk_q=blk_q, blk_k=blk_k,
                        scale=scale, causal=causal, dlse=dlse,
-                       interpret=interpret)
+                       interpret=jax.default_backend() != "tpu")
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)

@@ -78,6 +78,9 @@ class CheckpointManager:
         self._process_index = process_index
         self.sharded = sharded
         self.remote = remote
+        # replicated save folds the remote LATEST into its version choice
+        # once per manager lifetime (single mirror writer — see save())
+        self._remote_folded = False
 
     @property
     def process_index(self) -> int:
@@ -117,8 +120,18 @@ class CheckpointManager:
         if self.sharded:
             return self._save_sharded(state, status)
         if self.process_index != 0:
+            # Non-writers still accumulate sealed ckpt-N dirs locally via
+            # restore-time mirror fetches — prune them (sealed-only: no
+            # pending dirs exist in replicated mode, but keep symmetry
+            # with the sharded branch).
+            self._gc(sealed_only=True)
             return None
         latest = self.latest_version()
+        mirror_this = self.remote is not None
+        folded_now = False
+        if self.remote is not None and not self._remote_folded:
+            latest, folded_now = self._fold_remote_latest(latest)
+            mirror_this = folded_now
         version = 0 if latest is None else latest + 1
         os.makedirs(self.directory, exist_ok=True)
         host_state = jax.device_get(state)
@@ -135,9 +148,38 @@ class CheckpointManager:
             raise
         log.info("saved checkpoint %s (epoch=%d step=%d)",
                  self._path(version), status.epoch, status.step)
-        self._mirror(version)
+        if folded_now:
+            # Single mirror writer: once a fold reaches a SEALED local
+            # version, local latest >= remote latest by construction —
+            # skip the remote round-trip on subsequent saves. Only now:
+            # marking before the seal would let a failed write + retry
+            # skip the fold and renumber over a published checkpoint.
+            self._remote_folded = True
+        if mirror_this:
+            self._mirror(version)
         self._gc()
         return version
+
+    def _fold_remote_latest(self, latest: int | None
+                            ) -> tuple[int | None, bool]:
+        """Fold the mirror's LATEST into the version choice — a
+        cold-restarted rank 0 whose local dir is empty would otherwise
+        recompute a PUBLISHED version number, and mirroring it would
+        overwrite the published checkpoint / flip LATEST backwards.
+        Returns (folded latest, read_ok); on read_ok=False the caller
+        must skip this save's mirror (the next successful read resumes
+        numbering above the remote's)."""
+        from edl_tpu.utils import fs
+        try:
+            remote_latest = fs.remote_latest_version(self.remote)
+        except Exception as exc:  # noqa: BLE001 — mirror-only
+            log.warning("remote LATEST unreadable (%s) — skipping "
+                        "this save's mirror", exc)
+            return latest, False
+        if remote_latest is not None:
+            latest = remote_latest if latest is None else max(
+                latest, remote_latest)
+        return latest, True
 
     def _mirror(self, version: int) -> None:
         if self.remote is None:
@@ -179,22 +221,7 @@ class CheckpointManager:
         latest = self.latest_version()
         remote_read_ok = True
         if self.remote is not None and self.process_index == 0:
-            from edl_tpu.utils import fs
-            try:
-                remote_latest = fs.remote_latest_version(self.remote)
-            except Exception as exc:  # noqa: BLE001 — mirror-only
-                # With the remote view unknown, a cold-restarted rank 0
-                # could reuse a PUBLISHED version number — and the
-                # pre-upload clean would then delete the published
-                # checkpoint. Skip this save's mirror entirely (via the
-                # clean_ok broadcast); the next successful read resumes
-                # numbering above the remote's.
-                log.warning("remote LATEST unreadable (%s) — skipping "
-                            "this save's mirror", exc)
-                remote_latest, remote_read_ok = None, False
-            if remote_latest is not None:
-                latest = remote_latest if latest is None else max(
-                    latest, remote_latest)
+            latest, remote_read_ok = self._fold_remote_latest(latest)
         version = self._broadcast_int(0 if latest is None else latest + 1)
         os.makedirs(self.directory, exist_ok=True)
         tmp = os.path.join(self.directory, f".tmp-ckpt-{version}")
@@ -267,6 +294,13 @@ class CheckpointManager:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
         if self.process_index != 0:
+            # Non-zero pods never seal versions locally, but restore-time
+            # mirror fetches accumulate sealed ckpt-N dirs in their
+            # (non-shared) local dirs — prune those here; rank 0's full
+            # _gc below covers the shared/rank-0 case. Sealed-only: this
+            # rank's pending .tmp-ckpt dir must survive until rank 0
+            # renames it (shared dir) or the next save's clean sweeps it.
+            self._gc(sealed_only=True)
             return None
         log.info("saved sharded checkpoint %s (epoch=%d step=%d)",
                  self._path(version), status.epoch, status.step)
@@ -359,10 +393,12 @@ class CheckpointManager:
             log.warning("mirror of ckpt-%d to %s failed: %s", version,
                         self.remote, exc)  # not kill a sealed local save
 
-    def _gc(self) -> None:
+    def _gc(self, *, sealed_only: bool = False) -> None:
         versions = self.versions()
         for version in versions[: max(0, len(versions) - self.max_to_keep)]:
             shutil.rmtree(self._path(version), ignore_errors=True)
+        if sealed_only:
+            return
         # clean any orphaned temp dirs from crashed saves
         for name in os.listdir(self.directory):
             if name.startswith(".tmp-ckpt-"):

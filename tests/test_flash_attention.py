@@ -1,16 +1,22 @@
-"""Pallas flash attention vs the dense oracle (interpret mode on CPU).
+"""Pallas flash attention vs the dense oracle.
 
-The kernel itself runs under these tests (interpret=True executes the
-same kernel body), so block logic, causal skip, online-softmax
-accumulation, and the custom-vjp backward are all exercised off-TPU.
+Off-TPU the public API dispatches to compiled XLA blockwise paths, so
+every dense-parity test here runs under BOTH dispatch modes via the
+`attn_path` fixture: the XLA fallback, and the Pallas kernels forced
+through the same custom_vjp path in interpret mode (interpret=True
+executes the same kernel body) — block logic, causal skip,
+online-softmax accumulation, and both backwards stay covered on CPU.
 """
+
+import contextlib
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from edl_tpu.ops.flash_attention import flash_attention
+from edl_tpu.ops.flash_attention import (flash_attention,
+                                         force_interpret_kernels)
 from edl_tpu.parallel.ring_attention import dense_attention
 
 
@@ -20,34 +26,43 @@ def _qkv(b=2, s=256, h=4, d=64, dtype=jnp.float32, seed=0):
                                    (b, s, h, d), dtype) for i in range(3))
 
 
+@pytest.fixture(params=["xla_fallback", "pallas_kernels"])
+def attn_path(request):
+    """Run a test body under each off-TPU dispatch mode."""
+    ctx = (force_interpret_kernels() if request.param == "pallas_kernels"
+           else contextlib.nullcontext())
+    with ctx:
+        yield request.param
+
+
 class TestForward:
     @pytest.mark.parametrize("causal", [True, False])
-    def test_matches_dense(self, causal):
+    def test_matches_dense(self, causal, attn_path):
         q, k, v = _qkv()
         out = flash_attention(q, k, v, causal=causal,
                               block_q=128, block_k=128)
         want = dense_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(out, want, atol=2e-5)
 
-    def test_uneven_blocks(self):
+    def test_uneven_blocks(self, attn_path):
         q, k, v = _qkv(s=512)
         out = flash_attention(q, k, v, block_q=128, block_k=256)
         want = dense_attention(q, k, v)
         np.testing.assert_allclose(out, want, atol=2e-5)
 
-    def test_single_block(self):
+    def test_single_block(self, attn_path):
         q, k, v = _qkv(s=128)
         out = flash_attention(q, k, v)  # blocks clamp to S
         np.testing.assert_allclose(out, dense_attention(q, k, v),
                                    atol=2e-5)
 
-    def test_custom_scale(self):
+    def test_custom_scale(self, attn_path):
         q, k, v = _qkv(s=128)
         out = flash_attention(q, k, v, scale=0.05)
         want = dense_attention(q, k, v, scale=0.05)
         np.testing.assert_allclose(out, want, atol=2e-5)
 
-    def test_bf16_io(self):
+    def test_bf16_io(self, attn_path):
         q, k, v = _qkv(s=128, dtype=jnp.bfloat16)
         out = flash_attention(q, k, v)
         assert out.dtype == jnp.bfloat16
@@ -62,7 +77,7 @@ class TestForward:
         with pytest.raises(ValueError, match="divisible"):
             flash_attention(q, k, v, block_q=96)
 
-    def test_awkward_seq_len_auto_blocks(self):
+    def test_awkward_seq_len_auto_blocks(self, attn_path):
         """640 = 5x128: defaults must fall back to a block that divides
         S instead of raising (regression: auto mode crashed on any
         128-multiple that wasn't a 512-multiple)."""
@@ -78,7 +93,7 @@ class TestForward:
 
 
 class TestBackward:
-    def test_grads_match_dense(self):
+    def test_grads_match_dense(self, attn_path):
         q, k, v = _qkv(s=256)
 
         def f_flash(q, k, v):
@@ -93,7 +108,7 @@ class TestBackward:
         for a, b in zip(gf, gd):
             np.testing.assert_allclose(a, b, atol=5e-5)
 
-    def test_grads_noncausal(self):
+    def test_grads_noncausal(self, attn_path):
         q, k, v = _qkv(s=128)
 
         def f(fn):
@@ -106,6 +121,22 @@ class TestBackward:
             f(lambda q, k, v, causal: dense_attention(q, k, v,
                                                       causal=causal)),
             atol=5e-5)
+
+    def test_xla_fwd_fallback_matches_pallas_kernel(self):
+        """`_fwd_blockwise` (the compiled off-TPU forward) vs the Pallas
+        forward kernel in interpret mode: o AND lse, both causalities,
+        uneven blocks."""
+        from edl_tpu.ops.flash_attention import _fwd, _fwd_blockwise
+        for causal in (True, False):
+            q, k, v = _qkv(s=256)
+            scale = 1.0 / q.shape[-1] ** 0.5
+            o_ref, lse_ref = _fwd(q, k, v, blk_q=128, blk_k=64,
+                                  scale=scale, causal=causal,
+                                  interpret=True)
+            o_got, lse_got = _fwd_blockwise(q, k, v, blk=64, scale=scale,
+                                            causal=causal)
+            np.testing.assert_allclose(o_got, o_ref, atol=5e-6)
+            np.testing.assert_allclose(lse_got, lse_ref, atol=5e-6)
 
     def test_pallas_bwd_matches_xla_reference(self):
         """The Pallas dK/dV + dQ kernels vs `_bwd_blockwise` (the plain
@@ -149,7 +180,7 @@ class TestLseOutput:
         o = jnp.einsum("bhqk,bkhd->bqhd", jnp.exp(sc - lse[..., None]), v)
         return o, lse.transpose(0, 2, 1)
 
-    def test_lse_values(self):
+    def test_lse_values(self, attn_path):
         from edl_tpu.ops.flash_attention import flash_attention_lse
         q, k, v = _qkv(s=128)
         o, lse = flash_attention_lse(q, k, v, block_q=64, block_k=64)
@@ -157,7 +188,7 @@ class TestLseOutput:
         np.testing.assert_allclose(o, oo, atol=2e-5)
         np.testing.assert_allclose(lse, lo, atol=2e-5)
 
-    def test_lse_cotangent_flows(self):
+    def test_lse_cotangent_flows(self, attn_path):
         """Gradients through BOTH outputs (the ring-combine consumes
         lse differentiably) must match the dense oracle."""
         from edl_tpu.ops.flash_attention import flash_attention_lse
@@ -175,6 +206,18 @@ class TestLseOutput:
         go = loss(lambda q, k, v: self._oracle(q, k, v, 128))
         for a, b in zip(gf, go):
             np.testing.assert_allclose(a, b, atol=5e-5)
+
+    def test_dispatch_modes_agree_exactly_on_shapes(self):
+        """The two off-TPU paths must agree to numerical tolerance on a
+        multi-block causal case (guards dispatch-dependent drift)."""
+        from edl_tpu.ops.flash_attention import flash_attention_lse
+        q, k, v = _qkv(s=256)
+        o1, l1 = flash_attention_lse(q, k, v, block_q=128, block_k=128)
+        with force_interpret_kernels():
+            o2, l2 = flash_attention_lse(q, k, v, block_q=128,
+                                         block_k=128)
+        np.testing.assert_allclose(o1, o2, atol=2e-5)
+        np.testing.assert_allclose(l1, l2, atol=2e-5)
 
 
 class TestTransformerIntegration:

@@ -257,6 +257,109 @@ class TestCheckpointMirror:
         assert v == 0  # local save sealed despite the mirror failure
         assert mgr.restore(self._state(0.0)) is not None
 
+    def test_cold_rank0_replicated_save_continues_remote_numbering(
+            self, tmp_path):
+        """A cold-restarted rank 0 (empty local dir) saving BEFORE any
+        restore must number above the mirror's LATEST, not recompute
+        version 0 and overwrite the published remote ckpt-0."""
+        remote = str(tmp_path / "remote")
+        warm = CheckpointManager(str(tmp_path / "warm"), process_index=0,
+                                 remote=remote)
+        warm.save(self._state(1.0), TrainStatus(epoch=0, step=1,
+                                                world_size=1))
+        warm.save(self._state(2.0), TrainStatus(epoch=1, step=2,
+                                                world_size=1))
+        cold = CheckpointManager(str(tmp_path / "cold"), process_index=0,
+                                 remote=remote)
+        v = cold.save(self._state(9.0), TrainStatus(epoch=2, step=3,
+                                                    world_size=1))
+        assert v == 2  # continues above the remote's LATEST of 1
+        assert fslib.remote_latest_version(remote) == 2
+        # the published ckpt-0 payload is untouched
+        reader = CheckpointManager(str(tmp_path / "r"), process_index=0,
+                                   remote=remote)
+        out = reader.restore(self._state(0.0), version=0)
+        np.testing.assert_array_equal(out[0]["w"], self._state(1.0)["w"])
+
+    def test_cold_rank0_with_unreadable_remote_skips_mirror(
+            self, tmp_path, monkeypatch):
+        """If the remote LATEST cannot be read, the replicated save must
+        seal locally but NOT mirror (it could be reusing a published
+        version number)."""
+        remote = str(tmp_path / "remote")
+        warm = CheckpointManager(str(tmp_path / "warm"), process_index=0,
+                                 remote=remote)
+        warm.save(self._state(1.0), TrainStatus(epoch=0, step=1,
+                                                world_size=1))
+        monkeypatch.setattr(
+            fslib, "remote_latest_version",
+            lambda *a, **k: (_ for _ in ()).throw(fslib.EdlFsError("503")))
+        cold = CheckpointManager(str(tmp_path / "cold"), process_index=0,
+                                 remote=remote)
+        v = cold.save(self._state(9.0), TrainStatus(epoch=2, step=3,
+                                                    world_size=1))
+        assert v == 0  # local numbering only (remote view unknown)
+        monkeypatch.undo()
+        assert fslib.remote_latest_version(remote) == 0  # not overwritten
+        out = CheckpointManager(str(tmp_path / "r"), process_index=0,
+                                remote=remote).restore(self._state(0.0))
+        np.testing.assert_array_equal(out[0]["w"], self._state(1.0)["w"])
+
+    def test_failed_write_then_retry_still_folds_remote(
+            self, tmp_path, monkeypatch):
+        """A save whose local write FAILS after the remote fold must not
+        mark the fold done — the retry would skip it, recompute version
+        0, and overwrite the published remote ckpt-0."""
+        from flax import serialization as ser
+        remote = str(tmp_path / "remote")
+        warm = CheckpointManager(str(tmp_path / "warm"), process_index=0,
+                                 remote=remote)
+        warm.save(self._state(1.0), TrainStatus(epoch=0, step=1,
+                                                world_size=1))
+        warm.save(self._state(2.0), TrainStatus(epoch=1, step=2,
+                                                world_size=1))
+        cold = CheckpointManager(str(tmp_path / "cold"), process_index=0,
+                                 remote=remote)
+        monkeypatch.setattr(ser, "to_bytes",
+                            lambda *a: (_ for _ in ()).throw(
+                                OSError("disk full")))
+        with pytest.raises(OSError):
+            cold.save(self._state(9.0), TrainStatus(epoch=2, step=3,
+                                                    world_size=1))
+        monkeypatch.undo()
+        v = cold.save(self._state(9.0), TrainStatus(epoch=2, step=3,
+                                                    world_size=1))
+        assert v == 2  # retry re-folded, did not renumber from 0
+        assert fslib.remote_latest_version(remote) == 2
+        out = CheckpointManager(str(tmp_path / "r"), process_index=0,
+                                remote=remote).restore(self._state(0.0),
+                                                       version=0)
+        np.testing.assert_array_equal(out[0]["w"], self._state(1.0)["w"])
+
+    def test_nonzero_rank_prunes_fetched_sealed_versions(self, tmp_path):
+        """Restore-time mirror fetches accumulate sealed ckpt-N dirs on
+        non-zero pods' local dirs; a sharded save must prune them down to
+        max_to_keep even though only rank 0 runs the full _gc."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from edl_tpu.parallel.mesh import MeshSpec, make_mesh
+        local = tmp_path / "pod1"
+        for v in range(4):  # fetched copies of old versions
+            d = local / f"ckpt-{v}"
+            d.mkdir(parents=True)
+            (d / "meta.json").write_text(json.dumps({"version": v}))
+        mgr = CheckpointManager(str(local), process_index=1, sharded=True,
+                                max_to_keep=2)
+        mesh = make_mesh(MeshSpec({"dp": -1}))
+        arr = jax.device_put(np.arange(8, dtype=np.float32),
+                             NamedSharding(mesh, P()))
+        assert mgr.save({"w": arr}, TrainStatus(epoch=0, step=9,
+                                                world_size=1)) is None
+        assert mgr.versions() == [2, 3]
+        # the pending dir this rank just wrote must survive (rank 0 owns
+        # sealing it on shared dirs)
+        assert (local / ".tmp-ckpt-4").is_dir()
+
     def test_manager_without_remote_unchanged(self, tmp_path):
         mgr = CheckpointManager(str(tmp_path / "only"), process_index=0)
         mgr.save(self._state(1.0), TrainStatus(epoch=0, step=0, world_size=1))

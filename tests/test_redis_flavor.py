@@ -64,6 +64,19 @@ class TestRespWire:
         assert c.command("KEYS", "/svc/*") == ["/svc/b"]
         c.close()
 
+    def test_set_replaces_set_key_and_nx_is_type_agnostic(self, server):
+        c = RespClient(server.endpoint)
+        # real redis's NX existence check is type-agnostic: a set key
+        # blocks SET NX
+        c.command("SADD", "k1", "m")
+        assert c.command("SET", "k1", "v", "NX") is None
+        # plain SET replaces a key of ANY type (the set is gone after)
+        c.command("SADD", "k2", "m")
+        assert c.command("SET", "k2", "v") == "OK"
+        assert c.command("GET", "k2") == "v"
+        assert c.command("KEYS", "k2") == ["k2"]  # listed exactly once
+        c.close()
+
     def test_unknown_command_is_error(self, server):
         c = RespClient(server.endpoint)
         with pytest.raises(RespError):
@@ -127,6 +140,18 @@ class TestRedisStore:
             time.sleep(0.07)
             assert store.lease_keepalive(lease)
         assert store.get("/ka") is not None  # outlived 2x its ttl
+
+    def test_key_written_late_in_lease_expires_with_lease(self, store):
+        """A key SET near the END of a lease window must inherit the
+        lease's REMAINING ttl, not a fresh full one — a dead teacher
+        must not stay routable past its lease."""
+        lease = store.lease_grant(3.0)
+        time.sleep(1.8)  # most of the window gone, wide margin left
+        store.put("/late", "v", lease=lease)
+        assert store.get("/late") is not None
+        time.sleep(1.5)  # past the lease deadline, < full ttl from SET
+        assert not store.lease_keepalive(lease)  # lease itself is gone
+        assert store.get("/late") is None  # ...and so is the late key
 
     def test_lease_revoke_deletes(self, store):
         lease = store.lease_grant(5.0)
