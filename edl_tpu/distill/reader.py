@@ -21,7 +21,14 @@ tests/test_distill_reader.py under teacher kill/join):
       nothing is lost or duplicated across teacher churn;
   D4. the epoch terminates exactly when every sliced task has been served
       (feed-count == serve-count accounting, the poison-pill role);
-  D5. backpressure: at most ``2*teachers + 2`` tasks in flight.
+  D5. backpressure: at most ``2*teachers + 2`` tasks in flight;
+  D6. liveness: if NO connected teacher serves a task for
+      ``deadman_timeout`` seconds while work is outstanding AND some
+      teacher is known-dead, the epoch raises EdlDistillError naming the
+      dead teachers — a permanently connect-refusing fixed teacher fails
+      fast instead of hanging (the reference hangs in exactly this
+      case). A discovery pool that is legitimately empty (scale-to-zero)
+      keeps waiting for the balancer to reassign.
 """
 
 from __future__ import annotations
@@ -101,6 +108,7 @@ class _PredictWorker(threading.Thread):
         self.endpoint = endpoint
         self.stop_event = threading.Event()
         self.broken = threading.Event()
+        self.connected = threading.Event()  # client_factory succeeded
 
     def run(self) -> None:
         p = self.pipeline
@@ -109,8 +117,11 @@ class _PredictWorker(threading.Thread):
             client = p.client_factory(self.endpoint)
         except Exception as exc:
             log.warning("connect to teacher %s failed: %s", self.endpoint, exc)
+            p.dead_teachers[self.endpoint] = f"connect: {exc}"
             self.broken.set()
             return
+        self.connected.set()
+        p.dead_teachers.pop(self.endpoint, None)
         try:
             while not self.stop_event.is_set():
                 try:
@@ -125,6 +136,7 @@ class _PredictWorker(threading.Thread):
                     log.warning("teacher %s failed task %d (try %d): %s",
                                 self.endpoint, task.task_id, task.retries,
                                 exc)
+                    p.dead_teachers[self.endpoint] = f"predict: {exc}"
                     if task.retries > p.max_retries:
                         p.fail(f"task {task.task_id} failed "
                                f"{task.retries} times: {exc}")
@@ -159,6 +171,13 @@ class _EpochPipeline:
         self.reader_done = threading.Event()
         self.total_tasks = 0        # valid once reader_done is set
         self.total_batches = 0
+        # deadman facts: serves counted by the consumer, dead-teacher
+        # reasons recorded by workers, the clock owned by the manage
+        # thread (reset whenever a connected worker is live or no work
+        # is outstanding)
+        self.served_count = 0
+        self.dead_teachers: dict[str, str] = {}
+        self.deadman_ts = time.monotonic()
 
     def fail(self, msg: str) -> None:
         self.error.append(msg)
@@ -184,40 +203,71 @@ class _EpochPipeline:
             self._sem_slots -= 1
 
 
+_FMT_DICT = "dict"
+_FMT_SAMPLE = "sample"
+_FMT_SAMPLE_LIST = "sample_list"
+_FMT_BATCH = "batch"
+
+
 class DistillReader:
     """Wrap ``reader`` so iteration yields its batches + teacher predicts.
 
+    Native format: dict batches (equal leading dim) — ``DataLoader.epoch``
+    fits directly. The reference's three positional-slot reader formats
+    (distill_reader.py:313-329, fetch: distill_worker.py:656-781) are
+    supported as adapters over the same pipeline via ``ins=[...]`` +
+    ``set_sample_generator`` / ``set_sample_list_generator`` /
+    ``set_batch_generator``; iteration then yields the ORIGINAL structure
+    with prediction slots appended (per-sample tuples / sample lists /
+    stacked-array tuples respectively).
+
     Args:
-      reader: callable returning an iterator of dict batches (equal leading
-        dim), or an iterable of such batches. ``DataLoader.epoch(e)`` fits.
-      feeds: batch keys sent to the teacher.
+      reader: callable returning an iterator of dict batches, or an
+        iterable of such batches — or None when using the slot-format
+        setters (the reference's construction order).
+      feeds: batch keys sent to the teacher (dict format).
+      ins: positional slot spec for the slot formats — a name per slot,
+        ``None`` for passthrough slots not sent to the teacher (the
+        reference's ``ins=['img', None]``).
       predicts: teacher output names appended to each batch.
       teachers: fixed teacher endpoint list (reference set_fixed_teacher);
         OR
       discovery: endpoints of discovery servers + ``service`` for dynamic
-        teacher assignment.
+        teacher assignment. Both may instead be bound later via
+        ``set_fixed_teacher`` / ``set_dynamic_teacher``.
       teacher_batch_size: rows per teacher RPC (reference default 16).
+      deadman_timeout: seconds without any connected teacher serving a
+        task (while work is outstanding) before the epoch raises
+        EdlDistillError instead of waiting forever (invariant D6).
 
     Env: ``EDL_TPU_DISTILL_NOP=1`` swaps real connections for nop teachers
     (offline smoke; tests inject ``client_factory`` directly).
     """
 
-    def __init__(self, reader, feeds: Iterable[str],
-                 predicts: Iterable[str], *,
+    def __init__(self, reader=None, feeds: Iterable[str] | None = None,
+                 predicts: Iterable[str] = (), *,
+                 ins: Iterable[str | None] | None = None,
                  teachers: list[str] | None = None,
                  discovery: str | None = None, service: str | None = None,
                  teacher_batch_size: int = 16, max_retries: int = 3,
                  manage_interval: float = 0.5,
                  client_factory: Callable | None = None,
-                 rpc_timeout: float = 30.0):
-        if teachers is None and discovery is None:
-            raise EdlDistillError("need fixed `teachers` or `discovery`")
+                 rpc_timeout: float = 30.0,
+                 deadman_timeout: float = 60.0):
         self.reader = reader
-        self.feeds = tuple(feeds)
+        self._format = _FMT_DICT
+        self._ins = list(ins) if ins is not None else None
+        if feeds is not None:
+            self.feeds = tuple(feeds)
+        elif self._ins is not None:
+            self.feeds = tuple(n for n in self._ins if n is not None)
+        else:
+            self.feeds = ()
         self.predicts = tuple(predicts)
         self.teacher_batch_size = teacher_batch_size
         self.max_retries = max_retries
         self.manage_interval = manage_interval
+        self.deadman_timeout = deadman_timeout
         self._fixed_teachers = list(teachers) if teachers else None
         self._discovery_endpoints = discovery
         self._service = service
@@ -236,29 +286,143 @@ class DistillReader:
     def _get_servers(self) -> list[str]:
         if self._fixed_teachers is not None:
             return self._fixed_teachers
+        if self._discovery_endpoints is None:
+            raise EdlDistillError("need fixed `teachers` or `discovery` "
+                                  "(set_fixed_teacher / set_dynamic_teacher)")
         if self._discovery_client is None:
             from edl_tpu.distill.discovery_client import DiscoveryClient
             self._discovery_client = DiscoveryClient(
                 self._discovery_endpoints, self._service or "distill").start()
         return self._discovery_client.get_servers()
 
-    def set_fixed_teachers(self, teachers: list[str]) -> None:
-        """Swap the fixed teacher set (reference set_fixed_teacher)."""
+    def set_fixed_teacher(self, teachers: str | list[str]) -> "DistillReader":
+        """Swap in a fixed teacher set — a comma-joined endpoint string
+        or a list (reference set_fixed_teacher,
+        distill_reader.py:279-291)."""
+        if isinstance(teachers, str):
+            teachers = [t for t in teachers.split(",") if t]
         self._fixed_teachers = list(teachers)
+        return self
+
+    # historical spelling used by earlier rounds' docs
+    set_fixed_teachers = set_fixed_teacher
+
+    def set_dynamic_teacher(self, discovery_servers: str | list[str],
+                            teacher_service_name: str,
+                            require_max_teacher: int = 0
+                            ) -> "DistillReader":
+        """Bind discovery-mode teacher assignment after construction
+        (reference distill_reader.py:293-307). ``require_max_teacher`` is
+        accepted for signature parity; the balancer assigns shares
+        centrally (distill/balance.py), so a per-reader cap is not used.
+        """
+        if isinstance(discovery_servers, (list, tuple)):
+            discovery_servers = ",".join(discovery_servers)
+        self._fixed_teachers = None
+        self._discovery_endpoints = discovery_servers
+        self._service = teacher_service_name
+        return self
 
     def close(self) -> None:
         if self._discovery_client is not None:
             self._discovery_client.stop()
             self._discovery_client = None
 
+    # -- reference slot-format adapters -------------------------------------
+    # (distill_reader.py:313-329 setters; slicing read_sample/
+    # read_sample_list/read_batch and reassembly fetch_* in
+    # distill_worker.py:481-781 — here both directions are thin
+    # wrap/unwrap layers over the ONE dict pipeline, so all D1-D6
+    # invariants apply to every format for free.)
+
+    def set_sample_generator(self, reader) -> "DistillReader":
+        """Reader yields ONE sample per iteration: a tuple/list of
+        per-slot arrays matching ``ins``. Iteration then yields
+        per-sample tuples ``(*slots, *predicts)``."""
+        return self._set_slot_reader(reader, _FMT_SAMPLE)
+
+    def set_sample_list_generator(self, reader) -> "DistillReader":
+        """Reader yields a LIST of sample tuples per iteration; iteration
+        yields lists of the same length with predict slots appended to
+        each sample."""
+        return self._set_slot_reader(reader, _FMT_SAMPLE_LIST)
+
+    def set_batch_generator(self, reader) -> "DistillReader":
+        """Reader yields a tuple of stacked per-slot arrays (leading dim
+        = batch); iteration yields the same tuple with stacked predict
+        arrays appended."""
+        return self._set_slot_reader(reader, _FMT_BATCH)
+
+    def _set_slot_reader(self, reader, fmt: str) -> "DistillReader":
+        if self.reader is not None:
+            raise EdlDistillError("reader has already been set")
+        if self._ins is None:
+            raise EdlDistillError(
+                f"{fmt} readers are positional — construct DistillReader "
+                f"with ins=[...] (None marks passthrough slots)")
+        self.reader = reader
+        self._format = fmt
+        return self
+
+    def _slot_keys(self) -> list[str]:
+        return [n if n is not None else f"_slot{i}"
+                for i, n in enumerate(self._ins)]
+
+    def _wrap_slots(self, keys: list[str]) -> Iterator[dict]:
+        """Slot-format input -> the pipeline's dict batches. Samples are
+        grouped ``teacher_batch_size`` per dict batch (SAMPLE) or one
+        incoming list/batch per dict batch, so reassembly-by-batch
+        restores the original structure exactly."""
+        src = self.reader() if callable(self.reader) else iter(self.reader)
+
+        def pack(samples: list[tuple]) -> dict:
+            return {k: np.stack([s[i] for s in samples])
+                    for i, k in enumerate(keys)}
+
+        if self._format == _FMT_SAMPLE:
+            group: list[tuple] = []
+            for sample in src:
+                group.append(tuple(np.asarray(s) for s in sample))
+                if len(group) == self.teacher_batch_size:
+                    yield pack(group)
+                    group = []
+            if group:
+                yield pack(group)
+        elif self._format == _FMT_SAMPLE_LIST:
+            for sample_list in src:
+                yield pack([tuple(np.asarray(s) for s in sample)
+                            for sample in sample_list])
+        else:  # _FMT_BATCH
+            for batch in src:
+                yield {k: np.asarray(batch[i])
+                       for i, k in enumerate(keys)}
+
+    def _unwrap_slots(self, merged: dict, keys: list[str]) -> Iterator:
+        """One pipeline dict batch -> original-structure output(s) with
+        predict slots appended (the reference's fetch_sample/
+        fetch_sample_list/fetch_batch reassembly)."""
+        names = list(keys) + list(self.predicts)
+
+        def sample(i: int) -> tuple:
+            return tuple(merged[n][i] for n in names)
+
+        rows = merged[keys[0]].shape[0]
+        if self._format == _FMT_SAMPLE:
+            for i in range(rows):
+                yield sample(i)
+        elif self._format == _FMT_SAMPLE_LIST:
+            yield [sample(i) for i in range(rows)]
+        else:  # _FMT_BATCH: stacked arrays, originals untouched
+            yield tuple(merged[n] for n in names)
+
     # -- pipeline threads ---------------------------------------------------
 
-    def _reader_thread(self, p: _EpochPipeline) -> None:
+    def _reader_thread(self, p: _EpochPipeline, src) -> None:
         tl = timeline("distill.reader")
         task_id = 0
         batch_id = 0
         try:
-            it = self.reader() if callable(self.reader) else iter(self.reader)
+            it = src() if callable(src) else iter(src)
             for batch in it:
                 if p.stop.is_set():
                     return
@@ -307,16 +471,61 @@ class DistillReader:
                     workers[ep] = w
                     w.start()
             p.resize_window(len(workers))
+            # Epoch deadman: predict-time failures are bounded by
+            # max_retries, but a teacher whose CONNECT always fails is
+            # popped and re-created here every tick while queued tasks
+            # wait forever (the reference hangs in exactly this case).
+            # If no CONNECTED worker is live, work is outstanding, and
+            # nothing has been served for deadman_timeout — fail,
+            # naming the dead teachers. A discovery-mode pool that is
+            # legitimately EMPTY (scale-to-zero, preemption) is not a
+            # failure: the balancer will reassign, so the clock also
+            # resets while no known-dead teacher exists.
+            alive = any(w.is_alive() and w.connected.is_set()
+                        and not w.broken.is_set()
+                        for w in workers.values())
+            outstanding = not (p.reader_done.is_set()
+                               and p.served_count >= p.total_tasks)
+            empty_pool_ok = (self._fixed_teachers is None
+                             and not p.dead_teachers)
+            if alive or not outstanding or empty_pool_ok:
+                p.deadman_ts = time.monotonic()
+            elif (time.monotonic() - p.deadman_ts
+                  > self.deadman_timeout):
+                dead = ", ".join(f"{ep} ({why})" for ep, why in
+                                 sorted(p.dead_teachers.items())) \
+                    or "none registered"
+                p.fail(f"distill deadman: no live teacher served a task "
+                       f"for {self.deadman_timeout:.0f}s with work "
+                       f"outstanding; dead teachers: {dead}")
+                return
             if p.stop.wait(self.manage_interval):
                 return
 
     # -- the generator ------------------------------------------------------
 
-    def __call__(self) -> Iterator[dict]:
+    def __call__(self) -> Iterator:
+        """One epoch. Dict format yields merged dict batches; slot
+        formats yield the original structure with predicts appended."""
+        if self.reader is None:
+            raise EdlDistillError("must set a reader before iterating "
+                                  "(constructor arg or set_*_generator)")
+        if not self.feeds:
+            raise EdlDistillError(
+                "no teacher feeds configured — pass feeds=[...] (dict "
+                "format) or ins=[...] with at least one named slot")
+        if self._format == _FMT_DICT:
+            yield from self._dict_epoch(self.reader)
+            return
+        keys = self._slot_keys()
+        for merged in self._dict_epoch(lambda: self._wrap_slots(keys)):
+            yield from self._unwrap_slots(merged, keys)
+
+    def _dict_epoch(self, src) -> Iterator[dict]:
         p = _EpochPipeline(self)
         workers: dict[str, _PredictWorker] = {}
         threads = [
-            threading.Thread(target=self._reader_thread, args=(p,),
+            threading.Thread(target=self._reader_thread, args=(p, src),
                              daemon=True, name="distill-reader"),
             threading.Thread(target=self._manage_thread, args=(p, workers),
                              daemon=True, name="distill-manage"),
@@ -352,6 +561,7 @@ class DistillReader:
                         raise EdlDistillError(f"duplicate serve for {key}")
                     seen.add(key)
                     served_tasks += 1
+                    p.served_count = served_tasks  # deadman's progress fact
                     p.sem.release()
                     entry = pending.setdefault(task.batch_id, _Batch({}))
                     entry.parts[task.part] = outs

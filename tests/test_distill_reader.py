@@ -134,6 +134,184 @@ def test_all_teachers_failing_raises():
         list(dr())
 
 
+def test_connect_dead_teacher_trips_deadman_fast():
+    """A fixed teacher whose CONNECT always fails used to hang the epoch
+    forever (worker popped + re-created every manage tick, queued tasks
+    never served); the deadman must raise instead, naming the teacher
+    (invariant D6). The reference hangs in exactly this case."""
+    def refuse(ep):
+        raise ConnectionRefusedError(f"connection to {ep} refused")
+
+    batches = make_batches(n_batches=2, rows=8)
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"],
+                       teachers=["203.0.113.9:9999"],
+                       teacher_batch_size=4, manage_interval=0.05,
+                       deadman_timeout=1.0, client_factory=refuse)
+    t0 = time.monotonic()
+    with pytest.raises(EdlDistillError) as ei:
+        list(dr())
+    assert time.monotonic() - t0 < 10.0  # fails fast, not an epoch hang
+    assert "deadman" in str(ei.value)
+    assert "203.0.113.9:9999" in str(ei.value)  # names the dead teacher
+
+
+def test_slow_but_live_teacher_does_not_trip_deadman():
+    """A connected teacher serving slowly must never be mistaken for a
+    dead pool, even with per-predict latency above deadman_timeout."""
+    batches = make_batches(n_batches=2, rows=8)
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"], teachers=["slow"],
+                       teacher_batch_size=8, manage_interval=0.05,
+                       deadman_timeout=0.2,
+                       client_factory=lambda ep: _FnTeacherClient(
+                           ep, delay=0.3))
+    check_epoch(batches, list(dr()))
+
+
+def test_empty_discovery_pool_waits_instead_of_tripping():
+    """Scale-to-zero: a discovery pool with NO teachers (and none
+    known-dead) must keep waiting past deadman_timeout — the balancer
+    will reassign. A teacher arriving later completes the epoch."""
+    batches = make_batches(n_batches=2, rows=8)
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"],
+                       discovery="unused:0", service="svc",
+                       teacher_batch_size=4, manage_interval=0.05,
+                       deadman_timeout=0.3,
+                       client_factory=lambda ep: _FnTeacherClient(ep))
+    start = time.monotonic()
+    # empty pool well past deadman_timeout, then one teacher appears
+    dr._get_servers = lambda: ([] if time.monotonic() - start < 1.0
+                               else ["t0"])
+    check_epoch(batches, list(dr()))
+
+
+def test_missing_feeds_rejected_up_front():
+    dr = DistillReader(lambda: iter([]), predicts=["p"], teachers=["t"])
+    with pytest.raises(EdlDistillError, match="feeds"):
+        next(iter(dr()))
+
+
+def test_deadman_recovers_when_teacher_arrives_late():
+    """Teachers that appear BEFORE the deadman window elapses rescue the
+    epoch: the clock resets on any live connected worker."""
+    batches = make_batches(n_batches=3, rows=8)
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"], teachers=["late"],
+                       teacher_batch_size=4, manage_interval=0.05,
+                       deadman_timeout=2.0)
+    start = time.monotonic()
+
+    def late_factory(ep):
+        if time.monotonic() - start < 0.5:
+            raise ConnectionRefusedError("not up yet")
+        return _FnTeacherClient(ep)
+
+    dr._client_factory = late_factory
+    check_epoch(batches, list(dr()))
+
+
+class TestSlotFormats:
+    """The reference's three positional reader formats
+    (distill_reader.py:313-374): each must round-trip the ORIGINAL
+    structure value-exactly with predict slots appended."""
+
+    N_BATCHES, BATCH, FEAT = 4, 10, 6
+
+    def _samples(self):
+        rng = np.random.default_rng(7)
+        return [(rng.normal(size=(self.FEAT,)).astype(np.float32),
+                 np.int64(i % 3))
+                for i in range(self.N_BATCHES * self.BATCH)]
+
+    def _reader(self, ins=("image", None), **kw):
+        kw.setdefault("teachers", ["t0", "t1"])
+        kw.setdefault("teacher_batch_size", 4)
+        kw.setdefault("client_factory", lambda ep: _FnTeacherClient(ep))
+        return DistillReader(ins=list(ins),
+                             predicts=["teacher_logits"], **kw)
+
+    def test_sample_generator_roundtrip(self):
+        samples = self._samples()
+        dr = self._reader().set_sample_generator(lambda: iter(samples))
+        got = list(dr())
+        assert len(got) == len(samples)
+        for (img, label), out in zip(samples, got):
+            assert len(out) == 3  # (img, label, prediction)
+            np.testing.assert_array_equal(out[0], img)
+            np.testing.assert_array_equal(out[1], label)
+            np.testing.assert_allclose(
+                out[2], ref_logits(img[None])[0], rtol=1e-6)
+
+    def test_sample_list_generator_roundtrip(self):
+        samples = self._samples()
+        lists = [samples[i * self.BATCH:(i + 1) * self.BATCH]
+                 for i in range(self.N_BATCHES)]
+        dr = self._reader().set_sample_list_generator(lambda: iter(lists))
+        got = list(dr())
+        assert len(got) == self.N_BATCHES
+        for want, out in zip(lists, got):
+            assert len(out) == self.BATCH  # original list length restored
+            for (img, label), sample in zip(want, out):
+                np.testing.assert_array_equal(sample[0], img)
+                np.testing.assert_array_equal(sample[1], label)
+                np.testing.assert_allclose(
+                    sample[2], ref_logits(img[None])[0], rtol=1e-6)
+
+    def test_batch_generator_roundtrip(self):
+        rng = np.random.default_rng(8)
+        batches = [(rng.normal(size=(self.BATCH, self.FEAT))
+                    .astype(np.float32),
+                    rng.integers(0, 3, size=(self.BATCH, 1)))
+                   for _ in range(self.N_BATCHES)]
+        dr = self._reader().set_batch_generator(lambda: iter(batches))
+        got = list(dr())
+        assert len(got) == self.N_BATCHES
+        for (img, label), out in zip(batches, got):
+            assert len(out) == 3
+            np.testing.assert_array_equal(out[0], img)  # value-exact
+            np.testing.assert_array_equal(out[1], label)
+            np.testing.assert_allclose(out[2], ref_logits(img),
+                                       rtol=1e-6)
+
+    def test_reference_construction_order(self):
+        """The reference flow: construct with ins, bind teachers by
+        comma string AFTER, then set the reader — and reuse epochs."""
+        samples = self._samples()[:8]
+        dr = DistillReader(ins=["image", None],
+                           predicts=["teacher_logits"],
+                           teacher_batch_size=4,
+                           client_factory=lambda ep: _FnTeacherClient(ep))
+        dr.set_fixed_teacher("t0,t1")
+        dr.set_sample_generator(lambda: iter(samples))
+        for _ in range(2):
+            assert len(list(dr())) == len(samples)
+
+    def test_slot_reader_requires_ins(self):
+        dr = DistillReader(predicts=["p"], teachers=["t0"])
+        with pytest.raises(EdlDistillError, match="ins"):
+            dr.set_sample_generator(lambda: iter([]))
+
+    def test_double_set_reader_rejected(self):
+        dr = self._reader()
+        dr.set_sample_generator(lambda: iter([]))
+        with pytest.raises(EdlDistillError, match="already"):
+            dr.set_batch_generator(lambda: iter([]))
+
+    def test_no_reader_raises(self):
+        dr = DistillReader(ins=["x"], predicts=["p"], teachers=["t"])
+        with pytest.raises(EdlDistillError, match="reader"):
+            next(iter(dr()))
+
+    def test_reader_demo_example_all_formats(self):
+        """The reference reader-demo equivalent runs end-to-end over a
+        real TCP teacher (example/distill/reader_demo/
+        distill_reader_demo.py)."""
+        from edl_tpu.examples.reader_demo import main
+        assert main(["--format", "all"]) == 0
+
+
 def test_pad_to_bucket():
     assert pad_to_bucket(1, (1, 2, 4)) == 1
     assert pad_to_bucket(3, (1, 2, 4)) == 4
