@@ -158,7 +158,7 @@ class _EpochPipeline:
     """All shared state of one epoch's pipeline run."""
 
     def __init__(self, reader: "DistillReader"):
-        self.predicts = reader.predicts
+        self.predicts = reader._wire_predicts
         self.max_retries = reader.max_retries
         self.client_factory = reader._client_factory
         self.in_queue: queue.Queue = queue.Queue()
@@ -239,6 +239,14 @@ class DistillReader:
       deadman_timeout: seconds without any connected teacher serving a
         task (while work is outstanding) before the epoch raises
         EdlDistillError instead of waiting forever (invariant D6).
+      compress_topk: negotiate top-k+fp16 logit compression with the
+        teacher (~125x smaller response wire at 1000 classes, K=8; see
+        teacher_server.compress_outputs). Default: transparently
+        scatter-expanded back to dense fp32.
+      sparse_predicts: with compress_topk, skip the expansion and yield
+        ``name.idx``/``name.val`` pairs for sparse-aware losses
+        (train/classification.make_sparse_distill_step). Dict format
+        only.
 
     Env: ``EDL_TPU_DISTILL_NOP=1`` swaps real connections for nop teachers
     (offline smoke; tests inject ``client_factory`` directly).
@@ -253,7 +261,10 @@ class DistillReader:
                  manage_interval: float = 0.5,
                  client_factory: Callable | None = None,
                  rpc_timeout: float = 30.0,
-                 deadman_timeout: float = 60.0):
+                 deadman_timeout: float = 60.0,
+                 compress_topk: int = 0,
+                 compress_values: str = "float16",
+                 sparse_predicts: bool = False):
         self.reader = reader
         self._format = _FMT_DICT
         self._ins = list(ins) if ins is not None else None
@@ -264,6 +275,13 @@ class DistillReader:
         else:
             self.feeds = ()
         self.predicts = tuple(predicts)
+        self.sparse_predicts = sparse_predicts
+        # what actually travels: sparse mode receives name.idx/name.val
+        # pairs per predict (compress_outputs' naming) instead of the
+        # dense tensor — the pipeline reassembles THESE keys.
+        self._wire_predicts = tuple(
+            f"{n}{suffix}" for n in self.predicts
+            for suffix in ((".idx", ".val") if sparse_predicts else ("",)))
         self.teacher_batch_size = teacher_batch_size
         self.max_retries = max_retries
         self.manage_interval = manage_interval
@@ -272,13 +290,17 @@ class DistillReader:
         self._discovery_endpoints = discovery
         self._service = service
         self._discovery_client = None
+        if sparse_predicts and not compress_topk:
+            raise EdlDistillError("sparse_predicts requires compress_topk")
         if client_factory is None:
             if os.environ.get("EDL_TPU_DISTILL_NOP", "0") == "1":
                 client_factory = lambda ep: _NopTeacherClient(  # noqa: E731
-                    ep, self.predicts)
+                    ep, self._wire_predicts)
             else:
                 client_factory = lambda ep: TeacherClient(  # noqa: E731
-                    ep, timeout=rpc_timeout)
+                    ep, timeout=rpc_timeout, compress_topk=compress_topk,
+                    compress_values=compress_values,
+                    expand=not sparse_predicts)
         self._client_factory = client_factory
 
     # -- teacher set --------------------------------------------------------
@@ -356,6 +378,10 @@ class DistillReader:
     def _set_slot_reader(self, reader, fmt: str) -> "DistillReader":
         if self.reader is not None:
             raise EdlDistillError("reader has already been set")
+        if self.sparse_predicts:
+            raise EdlDistillError(
+                "sparse_predicts is dict-format only (slot formats "
+                "append dense prediction slots)")
         if self._ins is None:
             raise EdlDistillError(
                 f"{fmt} readers are positional — construct DistillReader "
@@ -572,7 +598,7 @@ class DistillReader:
                     entry = pending.pop(next_yield)
                     with tl.span("assemble"):
                         merged = dict(entry.batch)
-                        for name in self.predicts:
+                        for name in self._wire_predicts:
                             merged[name] = np.concatenate(
                                 [entry.parts[i][name]
                                  for i in range(entry.n_parts)], axis=0) \

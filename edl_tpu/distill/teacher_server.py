@@ -13,6 +13,19 @@ Protocol (tensor_wire frames):
     response meta {"ok": true}               tensors {fetch_name: array}
     request  meta {"op": "ping"}             -> {"ok": true}, no tensors
 
+Wire compression (two independent levers; see `compress_outputs`):
+  - client-negotiated: request meta carries {"compress": {"topk": K,
+    "values": "float16"}} and eligible dense outputs come back as
+    name.idx/name.val with meta {"compressed": {name: {...}}};
+  - server-side device top-k: predict_fn emits name.idx/name.val
+    directly (lax.top_k before the host transfer, CLI --serve-topk);
+    the server announces the same meta from `compressed_meta`.
+  Dense clients scatter-expand transparently (`expand_outputs`); sparse
+  clients (TeacherClient(expand=False)) consume idx/val as-is with
+  train/classification.py `make_sparse_distill_step`. Feeds travel in
+  the caller's dtype — send uint8 images and normalize teacher-side for
+  a 4x cheaper request direction.
+
 CLI (serves a zoo model with random or checkpointed params):
     python -m edl_tpu.distill.teacher_server --model mlp --port 23900
 """
@@ -192,9 +205,68 @@ class Batcher:
         self._thread.join(timeout=5.0)
 
 
+def compress_outputs(outs: dict[str, np.ndarray], spec: dict
+                     ) -> tuple[dict, dict[str, np.ndarray]]:
+    """Top-k + narrow-dtype compression of eligible prediction tensors.
+
+    ``spec`` = ``{"topk": K, "values": "float16"}`` (client-negotiated
+    per request). A 2-D floating (rows, classes) tensor with classes > K
+    becomes ``name.idx`` (uint16 when classes fit, else int32; sorted by
+    descending value) + ``name.val`` (K values in the narrow dtype);
+    everything else passes through unchanged. Returns a meta fragment
+    ``{"compressed": {name: {topk, classes, values}}}`` the client uses
+    to expand — at 1000 classes and K=8 this turns 4000 B/row of fp32
+    logits into 32 B/row, the lever the reference got from Paddle
+    Serving's fetch-var selection (distill_worker.py:203-226).
+    """
+    k = int(spec.get("topk", 0))
+    vdt = np.dtype(spec.get("values", "float16"))
+    compressed: dict[str, dict] = {}
+    out: dict[str, np.ndarray] = {}
+    for name, arr in outs.items():
+        if not (k > 0 and arr.ndim == 2 and arr.shape[1] > k
+                and np.issubdtype(arr.dtype, np.floating)):
+            out[name] = arr
+            continue
+        idx = np.argpartition(arr, -k, axis=1)[:, -k:]
+        vals = np.take_along_axis(arr, idx, axis=1)
+        order = np.argsort(-vals, axis=1)  # descending, deterministic
+        idx = np.take_along_axis(idx, order, axis=1)
+        vals = np.take_along_axis(vals, order, axis=1)
+        idt = (np.uint16 if arr.shape[1] - 1 <= np.iinfo(np.uint16).max
+               else np.int32)
+        out[name + ".idx"] = idx.astype(idt)
+        out[name + ".val"] = vals.astype(vdt)
+        compressed[name] = {"topk": k, "classes": int(arr.shape[1]),
+                            "values": vdt.str}
+    return ({"compressed": compressed} if compressed else {}), out
+
+
+# Non-top-k logit mass is impossible after expansion; this stands in for
+# -inf so softmax puts ~zero weight there without inf-arithmetic edges.
+EXPAND_FILL = -1e30
+
+
+def expand_outputs(meta: dict, tensors: dict[str, np.ndarray]
+                   ) -> dict[str, np.ndarray]:
+    """Scatter-expand a compressed response back to dense fp32 logits
+    (non-top-k entries get EXPAND_FILL), leaving downstream losses
+    unchanged. Inverse of `compress_outputs`."""
+    for name, info in (meta.get("compressed") or {}).items():
+        idx = tensors.pop(name + ".idx")
+        val = tensors.pop(name + ".val")
+        dense = np.full((idx.shape[0], int(info["classes"])), EXPAND_FILL,
+                        np.float32)
+        np.put_along_axis(dense, idx.astype(np.int64),
+                          val.astype(np.float32), axis=1)
+        tensors[name] = dense
+    return tensors
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         batcher: Batcher = self.server.batcher  # type: ignore[attr-defined]
+        server_meta: dict = getattr(self.server, "compressed_meta", {})
         sock: socket.socket = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         while True:
@@ -203,8 +275,8 @@ class _Handler(socketserver.BaseRequestHandler):
             except (tensor_wire.TensorWireError, OSError):
                 return
             try:
-                resp_meta, resp_tensors = self._dispatch(batcher, meta,
-                                                         tensors)
+                resp_meta, resp_tensors = self._dispatch(
+                    batcher, meta, tensors, server_meta)
             except Exception as exc:
                 resp_meta = {"ok": False,
                              "error": f"{type(exc).__name__}: {exc}"}
@@ -215,7 +287,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
 
     @staticmethod
-    def _dispatch(batcher: Batcher, meta: dict, tensors: dict):
+    def _dispatch(batcher: Batcher, meta: dict, tensors: dict,
+                  server_meta: dict | None = None):
         op = meta.get("op")
         if op == "ping":
             return {"ok": True}, {}
@@ -228,7 +301,27 @@ class _Handler(socketserver.BaseRequestHandler):
             req.done.wait()
             if req.error is not None:
                 return {"ok": False, "error": req.error}, {}
-            return {"ok": True}, req.result
+            out = req.result
+            compressed = {}
+            comp = meta.get("compress")
+            if comp:  # client-negotiated host-side top-k of dense outs
+                # never re-compress outputs the predict_fn already emits
+                # sparse (name.idx/name.val) — a smaller client K would
+                # otherwise shred name.val into name.val.idx/...
+                sparse = {k: v for k, v in out.items()
+                          if k.endswith((".idx", ".val"))}
+                frag, out = compress_outputs(
+                    {k: v for k, v in out.items() if k not in sparse},
+                    comp)
+                out.update(sparse)
+                compressed.update(frag.get("compressed", {}))
+            if server_meta:  # predict_fn emitted device-side sparse outs
+                compressed.update(
+                    {name: info for name, info in server_meta.items()
+                     if name + ".idx" in out})
+            if compressed:
+                return {"ok": True, "compressed": compressed}, out
+            return {"ok": True}, out
         return {"ok": False, "error": f"unknown op {op!r}"}, {}
 
 
@@ -247,11 +340,21 @@ class TeacherServer:
 
     def __init__(self, predict_fn, *, port: int = 0, host: str = "0.0.0.0",
                  max_batch: int = 64, max_wait: float = 0.002,
-                 buckets: tuple[int, ...] = DEFAULT_BUCKETS):
+                 buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+                 compressed_meta: dict[str, dict] | None = None):
+        """``compressed_meta``: announce that `predict_fn` ALREADY emits
+        sparse ``name.idx``/``name.val`` outputs (device-side
+        ``lax.top_k`` — only K values ever cross host<->device instead
+        of the full class row). Shape: ``{name: {"topk": K, "classes":
+        C, "values": "<f2"}}``; it is attached to predict responses so
+        dense clients scatter-expand transparently while sparse clients
+        consume as-is."""
         self.batcher = Batcher(predict_fn, max_batch=max_batch,
                                max_wait=max_wait, buckets=buckets)
+        self.compressed_meta = dict(compressed_meta or {})
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.batcher = self.batcher  # type: ignore[attr-defined]
+        self._server.compressed_meta = self.compressed_meta  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
         self._started = False
 
@@ -280,11 +383,23 @@ class TeacherServer:
 class TeacherClient:
     """Blocking client of one teacher server (used by DistillReader's
     predict workers; the reference counterpart wraps paddle_serving_client,
-    distill_worker.py:187-282)."""
+    distill_worker.py:187-282).
 
-    def __init__(self, endpoint: str, timeout: float = 30.0):
+    ``compress_topk > 0`` negotiates top-k+fp16 logit compression per
+    request (see `compress_outputs`); with ``expand=True`` (default) the
+    response is scatter-expanded back to dense fp32 transparently, with
+    ``expand=False`` the sparse ``name.idx``/``name.val`` pair is
+    returned for sparse-aware losses (train/classification.py
+    `make_sparse_distill_step`)."""
+
+    def __init__(self, endpoint: str, timeout: float = 30.0, *,
+                 compress_topk: int = 0, compress_values: str = "float16",
+                 expand: bool = True):
         from edl_tpu.utils.net import split_endpoint
         self.endpoint = endpoint
+        self.compress_topk = int(compress_topk)
+        self.compress_values = compress_values
+        self.expand = expand
         host, port = split_endpoint(endpoint)
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
@@ -292,11 +407,17 @@ class TeacherClient:
 
     def predict(self, feeds: dict[str, np.ndarray]
                 ) -> dict[str, np.ndarray]:
-        tensor_wire.send_tensors(self._sock, {"op": "predict"}, feeds)
+        meta: dict = {"op": "predict"}
+        if self.compress_topk > 0:
+            meta["compress"] = {"topk": self.compress_topk,
+                                "values": self.compress_values}
+        tensor_wire.send_tensors(self._sock, meta, feeds)
         meta, tensors = tensor_wire.recv_tensors(self._sock)
         if not meta.get("ok"):
             raise tensor_wire.TensorWireError(
                 meta.get("error", "predict failed"))
+        if self.expand:
+            tensors = expand_outputs(meta, tensors)
         return tensors
 
     def ping(self) -> bool:
@@ -326,8 +447,13 @@ class TeacherClient:
 def _build_model_predict(model_name: str, num_classes: int, params_path: str,
                          input_key: str, output_key: str,
                          input_shape: tuple[int, ...] = (32, 32, 3),
-                         input_dtype: str = "float32"):
-    """CLI helper: jitted zoo-model forward with random or restored params."""
+                         input_dtype: str = "float32",
+                         serve_topk: int = 0):
+    """CLI helper: jitted zoo-model forward with random or restored
+    params. ``serve_topk > 0``: `lax.top_k` runs ON DEVICE and only
+    (idx, val) pairs cross to host — at 1000 classes and K=16 that is a
+    62x smaller device->host pull per row, usually the serving
+    bottleneck after the feeds themselves."""
     import jax
     import jax.numpy as jnp
 
@@ -363,11 +489,26 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
         variables = {"params": state.params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
-        return model.apply(variables, images, train=False)
+        logits = model.apply(variables, images, train=False)
+        if serve_topk:
+            from jax import lax
+            val, idx = lax.top_k(logits.astype(jnp.float32), serve_topk)
+            return idx.astype(jnp.int32), val
+        return logits
 
-    def predict(feeds):
-        feed = jnp.asarray(feeds[input_key]).astype(jnp.dtype(input_dtype))
-        return {output_key: np.asarray(forward(feed), np.float32)}
+    if serve_topk:
+        def predict(feeds):
+            feed = jnp.asarray(feeds[input_key]).astype(
+                jnp.dtype(input_dtype))
+            idx, val = forward(feed)
+            return {output_key + ".idx": np.asarray(idx, np.int32),
+                    output_key + ".val":
+                        np.asarray(val).astype(np.float16)}
+    else:
+        def predict(feeds):
+            feed = jnp.asarray(feeds[input_key]).astype(
+                jnp.dtype(input_dtype))
+            return {output_key: np.asarray(forward(feed), np.float32)}
 
     return predict
 
@@ -392,14 +533,24 @@ def main(argv=None) -> int:
                         help="float32 for images, int32 for token ids")
     parser.add_argument("--max-batch", type=int, default=64)
     parser.add_argument("--max-wait-ms", type=float, default=2.0)
+    parser.add_argument("--serve-topk", type=int, default=0,
+                        help="device-side top-k: serve only K "
+                             "(idx, fp16 val) pairs per row instead of "
+                             "the dense class row")
     args = parser.parse_args(argv)
     shape = tuple(int(x) for x in args.input_shape.split(","))
     predict = _build_model_predict(args.model, args.num_classes, args.params,
                                    args.input_key, args.output_key, shape,
-                                   args.input_dtype)
+                                   args.input_dtype, args.serve_topk)
+    compressed_meta = None
+    if args.serve_topk:
+        compressed_meta = {args.output_key: {
+            "topk": args.serve_topk, "classes": args.num_classes,
+            "values": "<f2"}}
     server = TeacherServer(predict, port=args.port, host=args.host,
                            max_batch=args.max_batch,
-                           max_wait=args.max_wait_ms / 1000.0)
+                           max_wait=args.max_wait_ms / 1000.0,
+                           compressed_meta=compressed_meta)
     server.start()
     try:
         threading.Event().wait()

@@ -149,14 +149,11 @@ def make_classification_step(num_classes: int, *, smoothing: float = 0.0,
     return make_train_step(loss_fn, donate=donate)
 
 
-def make_distill_step(num_classes: int, *, temperature: float = 1.0,
-                      hard_weight: float = 0.0, smoothing: float = 0.0,
-                      donate: bool = True,
-                      input_key: str = "image") -> Callable:
-    """Step for {input_key,'label','teacher_logits'} batches: KD loss
-    (+ optional hard-label CE mix). The student-side consumer of the
-    DistillReader pipeline (reference distill/resnet train_with_fleet.py
-    soft-label path)."""
+def _make_kd_step(kd_loss: Callable, num_classes: int, *,
+                  hard_weight: float, smoothing: float, donate: bool,
+                  input_key: str) -> Callable:
+    """Shared KD step plumbing: `kd_loss(logits, batch) -> loss` is the
+    only thing that differs between the dense and sparse variants."""
 
     def loss_fn(state: TrainState, params: Any, batch: dict):
         variables = {"params": params}
@@ -170,7 +167,7 @@ def make_distill_step(num_classes: int, *, temperature: float = 1.0,
             logits = state.apply_fn(variables, batch[input_key],
                                     train=True)
             new_stats = None
-        loss = distill_kl(logits, batch["teacher_logits"], temperature)
+        loss = kd_loss(logits, batch)
         if hard_weight > 0.0:
             targets = smoothed_labels(batch["label"], num_classes, smoothing)
             loss = ((1.0 - hard_weight) * loss
@@ -181,6 +178,61 @@ def make_distill_step(num_classes: int, *, temperature: float = 1.0,
         return loss, aux
 
     return make_train_step(loss_fn, donate=donate)
+
+
+def make_distill_step(num_classes: int, *, temperature: float = 1.0,
+                      hard_weight: float = 0.0, smoothing: float = 0.0,
+                      donate: bool = True,
+                      input_key: str = "image") -> Callable:
+    """Step for {input_key,'label','teacher_logits'} batches: KD loss
+    (+ optional hard-label CE mix). The student-side consumer of the
+    DistillReader pipeline (reference distill/resnet train_with_fleet.py
+    soft-label path)."""
+
+    def kd_loss(logits, batch):
+        return distill_kl(logits, batch["teacher_logits"], temperature)
+
+    return _make_kd_step(kd_loss, num_classes, hard_weight=hard_weight,
+                         smoothing=smoothing, donate=donate,
+                         input_key=input_key)
+
+
+def sparse_distill_kl(student_logits: jax.Array, teacher_idx: jax.Array,
+                      teacher_val: jax.Array,
+                      temperature: float = 1.0) -> jax.Array:
+    """`distill_kl` against a TOP-K teacher: (B, K) indices + values from
+    the compressed teacher wire (distill/teacher_server.py
+    `compress_outputs`). Teacher probs renormalize over the k classes
+    (exactly what scatter-expanding with a -inf fill yields), and the
+    student's log-probs are gathered at the teacher's indices — the full
+    (B, C) dense teacher tensor never exists on device."""
+    t = temperature
+    teacher = jax.nn.softmax(teacher_val.astype(jnp.float32) / t, axis=-1)
+    logp = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t,
+                              axis=-1)
+    logp_k = jnp.take_along_axis(logp, teacher_idx.astype(jnp.int32),
+                                 axis=-1)
+    return -jnp.mean(jnp.sum(teacher * logp_k, axis=-1)) * t * t
+
+
+def make_sparse_distill_step(num_classes: int, *, temperature: float = 1.0,
+                             hard_weight: float = 0.0,
+                             smoothing: float = 0.0, donate: bool = True,
+                             input_key: str = "image",
+                             predict_key: str = "teacher_logits"
+                             ) -> Callable:
+    """`make_distill_step` for sparse teacher targets: batches carry
+    ``{predict_key}.idx`` / ``{predict_key}.val`` (DistillReader with
+    ``compress_topk=K, sparse_predicts=True``) instead of dense logits.
+    """
+
+    def kd_loss(logits, batch):
+        return sparse_distill_kl(logits, batch[predict_key + ".idx"],
+                                 batch[predict_key + ".val"], temperature)
+
+    return _make_kd_step(kd_loss, num_classes, hard_weight=hard_weight,
+                         smoothing=smoothing, donate=donate,
+                         input_key=input_key)
 
 
 def make_eval_step(input_key: str = "image",

@@ -1,0 +1,303 @@
+"""Teacher-wire compression: top-k+fp16 logits, sparse distill loss.
+
+The transport lever VERDICT r4 called the binding constraint on the
+distill e2e path: fp32 dense logits are 4 KB/row at 1000 classes; the
+negotiated top-k wire (distill/teacher_server.py compress_outputs /
+expand_outputs) cuts that ~125x at K=8 while keeping the distill loss
+exact w.r.t. the top-k renormalized teacher.
+"""
+
+import numpy as np
+import pytest
+
+from edl_tpu.distill.reader import DistillReader, EdlDistillError
+from edl_tpu.distill.teacher_server import (EXPAND_FILL, TeacherClient,
+                                            TeacherServer, compress_outputs,
+                                            expand_outputs)
+
+CLASSES = 1000
+
+
+def _logits(rows=6, classes=CLASSES, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(rows, classes)).astype(np.float32)
+
+
+class TestCompressExpand:
+    def test_roundtrip_preserves_topk(self):
+        arr = _logits()
+        frag, out = compress_outputs({"logits": arr},
+                                     {"topk": 8, "values": "float16"})
+        assert set(out) == {"logits.idx", "logits.val"}
+        assert out["logits.idx"].dtype == np.uint16  # 1000 classes fit
+        assert out["logits.val"].dtype == np.float16
+        dense = expand_outputs(dict(frag), dict(out))["logits"]
+        assert dense.shape == arr.shape and dense.dtype == np.float32
+        # each row: the top-8 survive (to fp16 precision), rest = fill
+        for r in range(arr.shape[0]):
+            top = np.argsort(-arr[r])[:8]
+            np.testing.assert_allclose(dense[r, top], arr[r, top],
+                                       rtol=1e-3)
+            rest = np.setdiff1d(np.arange(CLASSES), top)
+            assert (dense[r, rest] == EXPAND_FILL).all()
+
+    def test_values_sorted_descending(self):
+        _, out = compress_outputs({"l": _logits(3)}, {"topk": 5})
+        vals = out["l.val"].astype(np.float32)
+        assert (np.diff(vals, axis=1) <= 0).all()
+
+    def test_wide_head_uses_int32_indices(self):
+        arr = _logits(2, classes=70000, seed=1)
+        _, out = compress_outputs({"l": arr}, {"topk": 4})
+        assert out["l.idx"].dtype == np.int32
+
+    def test_ineligible_tensors_pass_through(self):
+        outs = {"emb": np.zeros((4, 2, 3), np.float32),   # 3-D
+                "ids": np.zeros((4, 100), np.int32),      # not floating
+                "tiny": np.zeros((4, 3), np.float32)}     # classes <= k
+        frag, out = compress_outputs(outs, {"topk": 8})
+        assert frag == {} and set(out) == set(outs)
+
+    def test_wire_bytes_shrink(self):
+        arr = _logits(16)
+        _, out = compress_outputs({"l": arr}, {"topk": 8})
+        dense_b = arr.nbytes
+        sparse_b = sum(a.nbytes for a in out.values())
+        assert sparse_b * 50 < dense_b  # >50x smaller at K=8/1000
+
+    def test_softmax_parity_with_exact_topk(self):
+        """softmax(expanded/T) == renormalized softmax over the true
+        top-k (the quality contract of the approximation)."""
+        arr = _logits(4)
+        frag, out = compress_outputs({"l": arr},
+                                     {"topk": 8, "values": "float32"})
+        dense = expand_outputs(dict(frag), dict(out))["l"]
+        t = 2.0
+        got = np.exp(dense / t) / np.exp(dense / t).sum(-1, keepdims=True)
+        for r in range(4):
+            top = np.argsort(-arr[r])[:8]
+            e = np.exp(arr[r, top] / t)
+            np.testing.assert_allclose(got[r, top], e / e.sum(),
+                                       rtol=1e-5)
+            assert got[r].sum() == pytest.approx(1.0, rel=1e-5)
+
+
+def _predict(feeds):
+    # Deterministic linear head over flattened image
+    x = feeds["image"].reshape(feeds["image"].shape[0], -1)
+    w = np.random.default_rng(3).normal(
+        size=(x.shape[1], CLASSES)).astype(np.float32)
+    return {"teacher_logits": (x.astype(np.float32) @ w)}
+
+
+class TestOverTheWire:
+    def test_client_negotiates_and_expands(self):
+        with TeacherServer(_predict, host="127.0.0.1") as srv:
+            feeds = {"image": np.random.default_rng(0).normal(
+                size=(4, 8)).astype(np.float32)}
+            plain = TeacherClient(f"127.0.0.1:{srv.port}")
+            dense = plain.predict(feeds)["teacher_logits"]
+            comp = TeacherClient(f"127.0.0.1:{srv.port}", compress_topk=8)
+            got = comp.predict(feeds)["teacher_logits"]
+            assert got.shape == dense.shape
+            for r in range(4):
+                top = np.argsort(-dense[r])[:8]
+                np.testing.assert_allclose(got[r, top], dense[r, top],
+                                           rtol=1e-3)
+            plain.close()
+            comp.close()
+
+    def test_sparse_client_returns_idx_val(self):
+        with TeacherServer(_predict, host="127.0.0.1") as srv:
+            c = TeacherClient(f"127.0.0.1:{srv.port}", compress_topk=4,
+                              expand=False)
+            out = c.predict({"image": np.ones((2, 8), np.float32)})
+            assert set(out) == {"teacher_logits.idx", "teacher_logits.val"}
+            assert out["teacher_logits.idx"].shape == (2, 4)
+            c.close()
+
+    def test_server_side_device_topk_announced_and_expanded(self):
+        """A predict_fn that already emits sparse idx/val (device-side
+        lax.top_k) + compressed_meta: dense clients expand transparently,
+        sparse clients get idx/val."""
+        dense_ref = {}
+
+        def sparse_predict(feeds):
+            logits = _predict(feeds)["teacher_logits"]
+            dense_ref["logits"] = logits
+            k = 8
+            idx = np.argsort(-logits, axis=1)[:, :k]
+            val = np.take_along_axis(logits, idx, axis=1)
+            return {"teacher_logits.idx": idx.astype(np.int32),
+                    "teacher_logits.val": val.astype(np.float16)}
+
+        meta = {"teacher_logits": {"topk": 8, "classes": CLASSES,
+                                   "values": "<f2"}}
+        with TeacherServer(sparse_predict, host="127.0.0.1",
+                           compressed_meta=meta) as srv:
+            feeds = {"image": np.random.default_rng(2).normal(
+                size=(3, 8)).astype(np.float32)}
+            dense_client = TeacherClient(f"127.0.0.1:{srv.port}")
+            got = dense_client.predict(feeds)["teacher_logits"]
+            assert got.shape == (3, CLASSES)
+            ref = dense_ref["logits"]
+            for r in range(3):
+                top = np.argsort(-ref[r])[:8]
+                np.testing.assert_allclose(got[r, top], ref[r, top],
+                                           rtol=1e-3)
+                assert (np.delete(got[r], top) == EXPAND_FILL).all()
+            dense_client.close()
+            sparse_client = TeacherClient(f"127.0.0.1:{srv.port}",
+                                          expand=False)
+            out = sparse_client.predict(feeds)
+            assert set(out) == {"teacher_logits.idx",
+                                "teacher_logits.val"}
+            sparse_client.close()
+
+    def test_client_negotiation_never_recompresses_sparse_outputs(self):
+        """A client whose compress_topk differs from the server's
+        device-side K must NOT have name.val shredded into
+        name.val.idx/name.val.val (regression)."""
+        def sparse_predict(feeds):
+            rows = feeds["image"].shape[0]
+            return {"teacher_logits.idx":
+                        np.tile(np.arange(8, dtype=np.int32), (rows, 1)),
+                    "teacher_logits.val":
+                        np.ones((rows, 8), np.float16)}
+
+        meta = {"teacher_logits": {"topk": 8, "classes": CLASSES,
+                                   "values": "<f2"}}
+        with TeacherServer(sparse_predict, host="127.0.0.1",
+                           compressed_meta=meta) as srv:
+            c = TeacherClient(f"127.0.0.1:{srv.port}", compress_topk=4,
+                              expand=False)  # smaller K than server's
+            out = c.predict({"image": np.zeros((2, 8), np.float32)})
+            assert set(out) == {"teacher_logits.idx",
+                                "teacher_logits.val"}
+            c.close()
+
+    def test_cli_serve_topk_predict_builder(self):
+        """--serve-topk path of the teacher CLI builder: device top-k,
+        sparse outputs, values fp16."""
+        from edl_tpu.distill.teacher_server import _build_model_predict
+        predict = _build_model_predict("mlp", 10, "", "image", "logits",
+                                       (8, 8, 1), "float32",
+                                       serve_topk=3)
+        out = predict({"image": np.zeros((2, 8, 8, 1), np.float32)})
+        assert set(out) == {"logits.idx", "logits.val"}
+        assert out["logits.idx"].shape == (2, 3)
+        assert out["logits.val"].dtype == np.float16
+        # descending and in-range
+        assert (np.diff(out["logits.val"].astype(np.float32),
+                        axis=1) <= 0).all()
+        assert (out["logits.idx"] >= 0).all()
+        assert (out["logits.idx"] < 10).all()
+
+    def test_uint8_feeds_ship_unchanged(self):
+        seen = {}
+
+        def spy_predict(feeds):
+            seen["dtype"] = feeds["image"].dtype
+            return _predict({"image": feeds["image"].astype(np.float32)})
+
+        with TeacherServer(spy_predict, host="127.0.0.1") as srv:
+            c = TeacherClient(f"127.0.0.1:{srv.port}")
+            c.predict({"image": np.zeros((2, 8), np.uint8)})
+            c.close()
+        assert seen["dtype"] == np.uint8  # 4x less feed bandwidth kept
+
+
+class TestReaderIntegration:
+    def _batches(self, n=3, rows=8):
+        rng = np.random.default_rng(5)
+        return [{"image": rng.normal(size=(rows, 8)).astype(np.float32),
+                 "label": rng.integers(0, CLASSES, size=(rows,))}
+                for _ in range(n)]
+
+    def test_reader_with_compression_transparent(self):
+        batches = self._batches()
+        with TeacherServer(_predict, host="127.0.0.1") as srv:
+            dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                               predicts=["teacher_logits"],
+                               teachers=[f"127.0.0.1:{srv.port}"],
+                               teacher_batch_size=4, compress_topk=8)
+            got = list(dr())
+        assert len(got) == len(batches)
+        for want, out in zip(batches, got):
+            assert out["teacher_logits"].shape == (8, CLASSES)
+            ref = _predict({"image": want["image"]})["teacher_logits"]
+            for r in range(8):
+                top = np.argsort(-ref[r])[:8]
+                np.testing.assert_allclose(out["teacher_logits"][r, top],
+                                           ref[r, top], rtol=1e-3)
+
+    def test_reader_sparse_mode_end_to_end(self):
+        batches = self._batches()
+        with TeacherServer(_predict, host="127.0.0.1") as srv:
+            dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                               predicts=["teacher_logits"],
+                               teachers=[f"127.0.0.1:{srv.port}"],
+                               teacher_batch_size=4, compress_topk=8,
+                               sparse_predicts=True)
+            got = list(dr())
+        for want, out in zip(batches, got):
+            assert out["teacher_logits.idx"].shape == (8, 8)
+            assert out["teacher_logits.val"].dtype == np.float16
+
+    def test_sparse_requires_topk(self):
+        with pytest.raises(EdlDistillError, match="compress_topk"):
+            DistillReader(lambda: iter([]), feeds=["x"], predicts=["p"],
+                          teachers=["t"], sparse_predicts=True)
+
+    def test_sparse_rejects_slot_formats(self):
+        dr = DistillReader(ins=["x"], predicts=["p"], teachers=["t"],
+                           compress_topk=4, sparse_predicts=True)
+        with pytest.raises(EdlDistillError, match="dict-format"):
+            dr.set_batch_generator(lambda: iter([]))
+
+
+class TestSparseLoss:
+    def test_sparse_kl_matches_dense_on_expanded(self):
+        """sparse_distill_kl == distill_kl over the scatter-expanded
+        teacher — exactly (same renormalized top-k distribution)."""
+        import jax.numpy as jnp
+        from edl_tpu.train.classification import (distill_kl,
+                                                  sparse_distill_kl)
+        student = _logits(4, seed=9)
+        teacher = _logits(4, seed=10)
+        frag, out = compress_outputs({"t": teacher},
+                                     {"topk": 8, "values": "float32"})
+        dense = expand_outputs(dict(frag), dict(out))["t"]
+        a = float(sparse_distill_kl(jnp.asarray(student),
+                                    jnp.asarray(out["t.idx"]
+                                                .astype(np.int32)),
+                                    jnp.asarray(out["t.val"]),
+                                    temperature=2.0))
+        b = float(distill_kl(jnp.asarray(student), jnp.asarray(dense),
+                             temperature=2.0))
+        assert a == pytest.approx(b, rel=1e-5)
+
+    def test_sparse_distill_step_trains(self):
+        import jax
+        import optax
+        from edl_tpu.models.mlp import MLP
+        from edl_tpu.train.classification import (create_state,
+                                                  make_sparse_distill_step)
+        model = MLP(num_classes=16, hidden=(8,))
+        state = create_state(model, jax.random.PRNGKey(0), (1, 4, 4, 1),
+                             optax.sgd(0.1))
+        step = make_sparse_distill_step(16, temperature=2.0,
+                                        hard_weight=0.3)
+        rng = np.random.default_rng(0)
+        teacher = rng.normal(size=(8, 16)).astype(np.float32)
+        _, out = compress_outputs({"teacher_logits": teacher}, {"topk": 4})
+        batch = {"image": rng.normal(size=(8, 4, 4, 1)).astype(np.float32),
+                 "label": rng.integers(0, 16, size=(8,)).astype(np.int32),
+                 "teacher_logits.idx": out["teacher_logits.idx"]
+                 .astype(np.int32),
+                 "teacher_logits.val": out["teacher_logits.val"]}
+        losses = []
+        for _ in range(5):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0]  # it learns the sparse targets

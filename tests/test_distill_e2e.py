@@ -147,6 +147,7 @@ def test_inflight_window_grows_when_teacher_joins():
 
     class _FakeReader:
         predicts = ("p",)
+        _wire_predicts = ("p",)
         max_retries = 3
         _client_factory = staticmethod(lambda ep: None)
 
