@@ -1,0 +1,275 @@
+"""Cluster metrics collector + trainer utilization publishing.
+
+Capability of the reference's scheduler data path
+(example/fit_a_line/collector.py:51-130 polls the cluster for
+phase/utilization metrics; discovery/register.py:36-40 reserves the
+registry ``info`` field for "report job performance to the scheduler"),
+redesigned over OUR source of truth: the coordination store, not the
+Kubernetes API — the store already holds live pod claims, the published
+cluster generation, and every service registrar's serving counters, so a
+scheduler gets one scrape point that works identically on k8s, bare
+metal, and in tests.
+
+Two halves:
+
+- `UtilizationPublisher` — trainer-side. A TrainLoop hook (same
+  ``(loop, epoch, step, metrics)`` signature) that writes this pod's
+  ``{epoch, step, samples_seen, examples_per_sec}`` to the leased key
+  ``/{job}/util/{pod_id}``; the lease makes staleness self-cleaning (a
+  dead trainer's utilization disappears after TTL). TrainLoop installs
+  one automatically when running under the elastic launcher
+  (EDL_TPU_RANK set) unless EDL_TPU_PUBLISH_UTIL=0.
+- `Collector` — scheduler-side. Snapshots a job (live rank claims,
+  published cluster generation, per-pod utilization) + any service
+  registries (teacher ``busy_s``/``served_rows``/... from
+  TeacherRegistrar stats) + store health (revision, key/leased-key
+  counts), emitted as one JSON object; the CLI prints one line per tick
+  for a scheduler to consume:
+
+      python -m edl_tpu.coord.collector --store h:p --job jid \
+          --services svc --interval 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Any
+
+from edl_tpu.coord.store import Store
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.coord.collector")
+
+
+def util_prefix(job_id: str) -> str:
+    return f"/{job_id}/util/"
+
+
+def util_key(job_id: str, pod_id: str) -> str:
+    return f"/{job_id}/util/{pod_id}"
+
+
+class UtilizationPublisher:
+    """Publish trainer progress to the pod's leased utilization record.
+
+    Callable with the TrainLoop hook signature, so wiring it is:
+    ``TrainLoop(..., hooks=[UtilizationPublisher(store, job, pod)])``.
+    A store hiccup never touches training: publishing is best-effort
+    with a cooldown after failures.
+    """
+
+    def __init__(self, store: Store, job_id: str, pod_id: str, *,
+                 rank: int = -1, ttl: float = 15.0,
+                 min_interval: float = 1.0):
+        self.store = store
+        self.job_id = job_id
+        self.pod_id = pod_id
+        self.rank = rank
+        self.ttl = ttl
+        self.min_interval = min_interval
+        self._lease: int | None = None
+        self._keeper = None
+        self._lock = threading.Lock()
+        self._last_pub = 0.0
+        # rate window seeds on the FIRST call: samples_seen may restore
+        # non-zero from a checkpoint, and measuring from 0 would report
+        # a wildly inflated examples_per_sec right after every resize
+        self._last_samples: int | None = None
+        self._last_t = time.monotonic()
+        self._cooldown_until = 0.0
+        self._owns_store = False  # from_env's connection: close on stop
+
+    @classmethod
+    def from_env(cls) -> "UtilizationPublisher | None":
+        """Build from the launcher's trainer env (TRAINER_ENV_VARS);
+        None when not under the elastic launcher or opted out."""
+        import os
+        if os.environ.get("EDL_TPU_PUBLISH_UTIL", "1") == "0":
+            return None
+        if "EDL_TPU_RANK" not in os.environ:
+            return None  # standalone run: nothing to publish into
+        endpoints = os.environ.get("EDL_TPU_STORE_ENDPOINTS", "")
+        job_id = os.environ.get("EDL_TPU_JOB_ID", "")
+        pod_id = os.environ.get("EDL_TPU_POD_ID", "")
+        if not (endpoints and job_id and pod_id):
+            return None
+        from edl_tpu.coord.redis_store import connect_store
+        try:
+            store = connect_store(endpoints.split(",")[0])
+        except Exception as exc:  # noqa: BLE001 — never block training
+            log.warning("utilization publisher disabled (store "
+                        "unreachable: %s)", exc)
+            return None
+        pub = cls(store, job_id, pod_id,
+                  rank=int(os.environ.get("EDL_TPU_RANK", "-1")))
+        pub._owns_store = True
+        return pub
+
+    def _ensure_lease(self) -> int:
+        if self._lease is not None and self._keeper is not None \
+                and not self._keeper.lost.is_set():
+            return self._lease
+        from edl_tpu.coord.client import LeaseKeeper
+        if self._keeper is not None:
+            self._keeper.stop(revoke=False)
+        self._lease = self.store.lease_grant(self.ttl)
+        self._keeper = LeaseKeeper(self.store, self._lease,
+                                   interval=self.ttl / 6.0).start()
+        return self._lease
+
+    def __call__(self, loop, epoch: int, step: int,
+                 metrics: dict | None = None) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if now < self._cooldown_until \
+                    or now - self._last_pub < self.min_interval:
+                return
+            samples = int(getattr(loop.status, "samples_seen", 0)) \
+                if loop is not None else 0
+            if self._last_samples is None:  # first call: no window yet
+                self._last_samples = samples
+                self._last_t = now
+            rate = (samples - self._last_samples) / max(
+                now - self._last_t, 1e-9) if samples > self._last_samples \
+                else 0.0
+            doc = {"pod_id": self.pod_id, "rank": self.rank,
+                   "epoch": int(epoch), "step": int(step),
+                   "samples_seen": samples,
+                   "examples_per_sec": round(max(rate, 0.0), 2),
+                   "ts": time.time()}
+            try:
+                self.store.put(util_key(self.job_id, self.pod_id),
+                               json.dumps(doc, sort_keys=True),
+                               lease=self._ensure_lease())
+            except Exception as exc:  # noqa: BLE001 — best-effort: a
+                # publishing failure of ANY kind must never kill training
+                log.warning("utilization publish failed (%s); pausing "
+                            "30s", exc)
+                self._cooldown_until = now + 30.0
+                self._lease = None
+                return
+            self._last_pub = now
+            self._last_samples = samples
+            self._last_t = now
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._keeper is not None:
+                self._keeper.stop(revoke=True)
+                self._keeper = None
+                self._lease = None
+            if self._owns_store:
+                self._owns_store = False
+                try:
+                    self.store.close()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
+
+
+def _parse_info(info: str) -> Any:
+    if not info:
+        return {}
+    try:
+        return json.loads(info)
+    except json.JSONDecodeError:
+        return info  # registrars may publish plain strings
+
+
+class Collector:
+    """One scrape point for a scheduler: job membership + utilization,
+    service registries, store health (module docstring has the map)."""
+
+    def __init__(self, store: Store, job_id: str | None = None,
+                 services: tuple[str, ...] = (),
+                 registry_root: str = "edl"):
+        self.store = store
+        self.job_id = job_id
+        self.services = tuple(services)
+        self.registry_root = registry_root
+
+    def _job_snapshot(self, job_id: str) -> dict:
+        from edl_tpu.collective import register as reg
+        from edl_tpu.collective.cluster import Cluster
+        pods, _ = reg.live_pods(self.store, job_id)
+        cluster_rec = self.store.get(reg.cluster_key(job_id))
+        generation, world = None, None
+        if cluster_rec is not None:
+            cluster = Cluster.from_json(cluster_rec.value)
+            generation, world = cluster.version, cluster.world_size
+        util_recs, _ = self.store.get_prefix(util_prefix(job_id))
+        util = {}
+        for rec in util_recs:
+            util[rec.key.rsplit("/", 1)[-1]] = _parse_info(rec.value)
+        complete = self.store.get(reg.complete_key(job_id)) is not None
+        return {"job_id": job_id,
+                "generation": generation,
+                "world_size": world,
+                "complete": complete,
+                "pods": [{"pod_id": p.pod_id,
+                          "claimed_rank": p.claimed_rank,
+                          "addr": p.addr, "n_devices": p.n_devices,
+                          "utilization": util.get(p.pod_id)}
+                         for p in pods]}
+
+    def _service_snapshot(self, service: str) -> list[dict]:
+        from edl_tpu.coord.registry import ServiceRegistry
+        registry = ServiceRegistry(self.store, root=self.registry_root)
+        return [{"server": m.server, "info": _parse_info(m.info)}
+                for m in registry.get_service(service)]
+
+    def snapshot(self) -> dict:
+        records, revision = self.store.get_prefix("")
+        doc: dict = {"ts": time.time(),
+                     "store": {"revision": revision,
+                               "keys": len(records),
+                               "leased_keys": sum(
+                                   1 for r in records if r.lease)}}
+        if self.job_id:
+            doc["job"] = self._job_snapshot(self.job_id)
+        if self.services:
+            doc["services"] = {s: self._service_snapshot(s)
+                               for s in self.services}
+        return doc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="edl_tpu.coord.collector",
+        description="Scrape job/service/store metrics as JSON lines")
+    parser.add_argument("--store", required=True,
+                        help="store endpoint (host:port or redis://...)")
+    parser.add_argument("--job", default="",
+                        help="job id to snapshot (/{job}/ keys)")
+    parser.add_argument("--services", default="",
+                        help="comma-joined service registry names")
+    parser.add_argument("--registry-root", default="edl")
+    parser.add_argument("--interval", type=float, default=5.0)
+    parser.add_argument("--once", action="store_true",
+                        help="emit one snapshot and exit")
+    args = parser.parse_args(argv)
+
+    from edl_tpu.coord.redis_store import connect_store
+    store = connect_store(args.store)
+    services = tuple(s for s in args.services.split(",") if s)
+    collector = Collector(store, job_id=args.job or None,
+                          services=services,
+                          registry_root=args.registry_root)
+    try:
+        while True:
+            print(json.dumps(collector.snapshot(), sort_keys=True),
+                  flush=True)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        store.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,6 +94,18 @@ class TrainLoop:
         # try_restore(); None until a restore happens. Consumers use it to
         # rescale LR/batch after an elastic resize (lr.scale_for_world).
         self.saved_world_size: int | None = None
+        # Under the elastic launcher, publish step rate / samples_seen
+        # into the pod's leased /{job}/util/ record so the Collector
+        # (scheduler data path, reference discovery/register.py:36-40
+        # `info`) sees fresh trainer utilization. No-op standalone, and
+        # never blocks training: a failure here only disables publishing.
+        try:
+            from edl_tpu.coord.collector import UtilizationPublisher
+            self._util_publisher = UtilizationPublisher.from_env()
+        except Exception:  # noqa: BLE001 — observability is optional
+            self._util_publisher = None
+        if self._util_publisher is not None:
+            self.hooks = list(self.hooks) + [self._util_publisher]
 
     # -- checkpoint glue ---------------------------------------------------
 
@@ -137,26 +149,34 @@ class TrainLoop:
         elastic restart replays the same order — reference reader_cv2
         pass_id_as_seed, train_with_fleet.py:459-464).
         """
-        self.try_restore()
-        cfg = self.config
-        start_epoch = self.status.next_epoch()
-        if start_epoch >= cfg.num_epochs:
-            log.info("training already complete (epoch=%d)", self.status.epoch)
+        try:
+            self.try_restore()
+            cfg = self.config
+            start_epoch = self.status.next_epoch()
+            if start_epoch >= cfg.num_epochs:
+                log.info("training already complete (epoch=%d)",
+                         self.status.epoch)
+                return self.status
+            for epoch in range(start_epoch, cfg.num_epochs):
+                self._run_epoch(epoch, data_fn, batch_size_fn)
+                self.status.epoch = epoch
+                self.status.step_in_epoch = 0
+                if (epoch + 1) % max(1, cfg.ckpt_every_epochs) == 0 \
+                        or epoch == cfg.num_epochs - 1:
+                    self._save()
+                if self.eval_fn is not None:
+                    results = self.eval_fn(self.state, epoch)
+                    log.info("eval epoch %d: %s", epoch, _fmt(results))
+            if self._profiling:  # run shorter than the window: still flush
+                jax.profiler.stop_trace()
+                self._profiling = False
             return self.status
-        for epoch in range(start_epoch, cfg.num_epochs):
-            self._run_epoch(epoch, data_fn, batch_size_fn)
-            self.status.epoch = epoch
-            self.status.step_in_epoch = 0
-            if (epoch + 1) % max(1, cfg.ckpt_every_epochs) == 0 \
-                    or epoch == cfg.num_epochs - 1:
-                self._save()
-            if self.eval_fn is not None:
-                results = self.eval_fn(self.state, epoch)
-                log.info("eval epoch %d: %s", epoch, _fmt(results))
-        if self._profiling:  # run shorter than the window: still flush
-            jax.profiler.stop_trace()
-            self._profiling = False
-        return self.status
+        finally:
+            # Even on a crash or the already-complete early return, the
+            # lease must be revoked so a dead trainer's utilization
+            # record expires instead of being kept fresh forever.
+            if self._util_publisher is not None:
+                self._util_publisher.stop()
 
     def _profile_window(self) -> None:
         """Start/stop the jax profiler trace at the configured global

@@ -22,6 +22,33 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_NUM_CPU_DEVICES"] = os.environ["EDL_TPU_TEST_DEVICES"]
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", int(os.environ["EDL_TPU_TEST_DEVICES"]))
+
+
+# -- test tiers ------------------------------------------------------------
+# `pytest -q` = the fast tier (minutes on one core); the multi-process
+# integration suites are @pytest.mark.slow and run with `--runslow`
+# (CI runs both tiers — .github/workflows/ci.yml).
+
+def pytest_addoption(parser):
+    parser.addoption("--runslow", action="store_true", default=False,
+                     help="also run tests marked slow (multi-process "
+                          "integration; ~15 extra minutes on one core)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process integration tests excluded from "
+                   "the default run (enable with --runslow)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow tier: pass --runslow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

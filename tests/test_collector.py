@@ -1,0 +1,177 @@
+"""Collector + UtilizationPublisher: the scheduler data path.
+
+Unit tier (InMemStore, no processes); the live-elastic-job integration
+assertion rides test_multipod.py's launcher test (slow tier).
+Capability of /root/reference/example/fit_a_line/collector.py:51-130 +
+the reserved registry info field (discovery/register.py:36-40).
+"""
+
+import json
+import time
+
+import numpy as np
+
+from edl_tpu.collective.cluster import Cluster, Pod
+from edl_tpu.collective.register import cluster_key, rank_key
+from edl_tpu.coord.collector import (Collector, UtilizationPublisher,
+                                     util_key)
+from edl_tpu.coord.store import InMemStore
+
+
+def _seed_job(store, job="j1"):
+    for i, pod_id in enumerate(("podA", "podB")):
+        lease = store.lease_grant(5.0)
+        store.put(rank_key(job, i),
+                  Pod(pod_id=pod_id, addr="10.0.0.%d" % i, n_devices=4,
+                      claimed_rank=i, rank=i).to_json(), lease=lease)
+    cluster = Cluster(job_id=job, version=3,
+                      pods=[Pod(pod_id="podA", addr="10.0.0.0", rank=0),
+                            Pod(pod_id="podB", addr="10.0.0.1", rank=1)])
+    store.put(cluster_key(job), cluster.to_json())
+
+
+class TestCollector:
+    def test_job_snapshot_pods_generation_utilization(self):
+        store = InMemStore()
+        _seed_job(store)
+        store.put(util_key("j1", "podA"),
+                  json.dumps({"step": 40, "samples_seen": 640,
+                              "examples_per_sec": 93.5}),
+                  lease=store.lease_grant(5.0))
+        snap = Collector(store, job_id="j1").snapshot()
+        job = snap["job"]
+        assert job["generation"] == 3 and job["world_size"] == 2
+        assert not job["complete"]
+        pods = {p["pod_id"]: p for p in job["pods"]}
+        assert pods["podA"]["utilization"]["examples_per_sec"] == 93.5
+        assert pods["podB"]["utilization"] is None  # none published yet
+        assert snap["store"]["revision"] > 0
+        assert snap["store"]["leased_keys"] >= 3
+
+    def test_service_snapshot_surfaces_teacher_counters(self):
+        """A teacher's busy_s / served_rows reach the collector through
+        the registrar's info field (the done-criterion of VERDICT r4
+        next-step 7)."""
+        from edl_tpu.coord.registry import ServiceRegistry
+        store = InMemStore()
+        registry = ServiceRegistry(store)
+        registration = registry.register("svc", "10.0.0.9:2390", ttl=5.0)
+        registration.update_info(json.dumps(
+            {"busy_s": 12.5, "served_rows": 4096, "rows_per_sec": 327.0}))
+        try:
+            snap = Collector(store, services=("svc",)).snapshot()
+            (meta,) = snap["services"]["svc"]
+            assert meta["server"] == "10.0.0.9:2390"
+            assert meta["info"]["busy_s"] == 12.5
+            assert meta["info"]["served_rows"] == 4096
+        finally:
+            registration.stop()
+
+    def test_cli_once_emits_one_json_line(self, capsys):
+        """The CLI path over a real TCP store server."""
+        import subprocess
+        import sys
+
+        from edl_tpu.coord.client import StoreClient
+        from edl_tpu.utils import net
+        port = net.free_port()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "edl_tpu.coord.server",
+             "--port", str(port)], stderr=subprocess.DEVNULL)
+        try:
+            client = StoreClient(f"127.0.0.1:{port}")
+            deadline = time.time() + 15
+            while time.time() < deadline and not client.ping():
+                time.sleep(0.2)
+            _seed_job(client, job="jcli")
+            from edl_tpu.coord.collector import main
+            assert main(["--store", f"127.0.0.1:{port}", "--job", "jcli",
+                         "--once"]) == 0
+            line = capsys.readouterr().out.strip()
+            doc = json.loads(line)
+            assert doc["job"]["generation"] == 3
+            assert {p["pod_id"] for p in doc["job"]["pods"]} == \
+                {"podA", "podB"}
+            client.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+
+class TestUtilizationPublisher:
+    class _Loop:
+        class status:
+            samples_seen = 0
+
+    def test_publishes_rate_and_samples(self):
+        store = InMemStore()
+        pub = UtilizationPublisher(store, "j1", "podA", rank=1,
+                                   min_interval=0.0)
+        loop = self._Loop()
+        loop.status.samples_seen = 128
+        pub(loop, epoch=0, step=10, metrics={})
+        rec = store.get(util_key("j1", "podA"))
+        doc = json.loads(rec.value)
+        assert doc["samples_seen"] == 128 and doc["rank"] == 1
+        assert doc["step"] == 10
+        assert rec.lease  # leased: stale records self-clean
+        loop.status.samples_seen = 256
+        pub(loop, epoch=0, step=20, metrics={})
+        doc = json.loads(store.get(util_key("j1", "podA")).value)
+        assert doc["samples_seen"] == 256
+        assert doc["examples_per_sec"] > 0
+        pub.stop()
+        assert store.get(util_key("j1", "podA")) is None  # lease revoked
+
+    def test_store_failure_never_raises(self):
+        class _Broken:
+            def lease_grant(self, ttl):
+                raise OSError("store down")
+
+        pub = UtilizationPublisher(_Broken(), "j", "p", min_interval=0.0)
+        loop = self._Loop()
+        pub(loop, 0, 1, {})  # must swallow, training goes on
+        pub.stop()
+
+    def test_from_env_requires_launcher_context(self, monkeypatch):
+        monkeypatch.delenv("EDL_TPU_RANK", raising=False)
+        assert UtilizationPublisher.from_env() is None
+        monkeypatch.setenv("EDL_TPU_PUBLISH_UTIL", "0")
+        monkeypatch.setenv("EDL_TPU_RANK", "0")
+        assert UtilizationPublisher.from_env() is None
+
+
+def test_publisher_as_trainloop_hook_end_to_end():
+    """TrainLoop auto-installs nothing standalone; with an explicit
+    publisher hook, a short real training run publishes utilization."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.train.loop import LoopConfig, TrainLoop
+    from edl_tpu.train.state import TrainState
+    from edl_tpu.train.step import make_train_step
+
+    store = InMemStore()
+    pub = UtilizationPublisher(store, "jobX", "podX", rank=0,
+                               min_interval=0.0)
+    params = {"w": jnp.zeros((4,))}
+    state = TrainState.create(apply_fn=None, params=params,
+                              tx=optax.sgd(0.1))
+
+    def loss_fn(state, params, batch):
+        return jnp.sum((batch["x"] @ params["w"] - batch["y"]) ** 2), {}
+
+    step = make_train_step(loss_fn, donate=False)
+    rng = np.random.default_rng(0)
+    batches = [{"x": rng.normal(size=(8, 4)).astype(np.float32),
+                "y": rng.normal(size=(8,)).astype(np.float32)}
+               for _ in range(4)]
+    loop = TrainLoop(step, state, config=LoopConfig(num_epochs=1,
+                                                    log_every_steps=1),
+                     hooks=[pub])
+    loop.run(lambda epoch: iter(batches))
+    # stop() ran inside run()? no — explicit hooks are caller-owned
+    doc = json.loads(store.get(util_key("jobX", "podX")).value)
+    assert doc["samples_seen"] == 32
+    pub.stop()

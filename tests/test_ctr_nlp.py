@@ -94,6 +94,7 @@ class TestDeepFM:
 
 
 class TestCtrPipeline:
+    @pytest.mark.slow
     def test_ctr_train_end_to_end(self, tmp_path):
         """Full CLI path: synthesize files, dispense via TaskMaster, train,
         AUC improves over chance, benchmark log written."""
@@ -150,6 +151,7 @@ class TestCtrPipeline:
 
 
 class TestNlpDistillPipeline:
+    @pytest.mark.slow
     def test_distill_beats_alone(self):
         """The full wire pipeline at tiny scale: teacher serves over TCP,
         student distills through DistillReader; distilled student must not

@@ -7,6 +7,10 @@ teacher join AND a teacher kill mid-run — the "elastically resized teacher
 pool, student unaffected" pillar (README.md:27-31).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # the full distill stack with teacher churn
+
 import time
 
 import numpy as np

@@ -17,6 +17,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # real process kills under the launcher
+
 from edl_tpu.coord.client import StoreClient
 from edl_tpu.collective import register as reg
 from edl_tpu.collective.barrier import read_cluster

@@ -111,6 +111,7 @@ class TestFusedLmLoss:
         for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
             np.testing.assert_allclose(a, b, atol=2e-6)
 
+    @pytest.mark.slow
     def test_lm_train_fused_flag(self, tmp_path):
         from edl_tpu.examples.lm_train import main
 

@@ -247,6 +247,7 @@ class TestMidEpochResumeOverDecodePool:
 
 
 class TestFlagshipJpegMode:
+    @pytest.mark.slow
     def test_imagenet_train_jpeg_end_to_end(self, tmp_path):
         """The flagship trainer over the JPEG plane: synthetic JPEGs +
         train.txt, pooled decode/augment, on-device normalization."""

@@ -14,6 +14,10 @@ step consumes the same sample SET as a single-process run with the
 global batch — so accuracy must match up to reduction order.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # the flagship trainer under the real elastic launcher
+
 import json
 import os
 import subprocess

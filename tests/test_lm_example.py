@@ -1,5 +1,9 @@
 """lm_train example: transformer pretraining over file-backed shards."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # LM trainer end-to-end epochs
+
 import json
 
 import numpy as np

@@ -10,6 +10,8 @@ import numpy as np
 import optax
 import pytest
 
+pytestmark = pytest.mark.slow  # zoo forwards/steps compile ResNet-class graphs (~2.5 min on one CPU core)
+
 from edl_tpu.models.resnet import ResNet, ResNetTiny, ResNet50_vd
 from edl_tpu.models.vgg import VGG
 from edl_tpu.train import classification as cls

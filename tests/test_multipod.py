@@ -23,6 +23,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-process jax.distributed worlds
+
 from edl_tpu.utils import net
 
 DEMO = "edl_tpu.examples.multipod_demo"
@@ -141,8 +143,12 @@ def start_launcher(store_addr, tmp_path, name, epochs, step_time):
 def test_launcher_forms_one_world_and_survives_resize(store_server, tmp_path):
     from edl_tpu.collective.barrier import read_cluster
     store_addr, client = store_server
-    a = start_launcher(store_addr, tmp_path, "podA", epochs=5, step_time=0.3)
-    b = start_launcher(store_addr, tmp_path, "podB", epochs=5, step_time=0.3)
+    # step_time sized so the kill (after ckpt + first utilization poll)
+    # lands MID-training: the resized world must still have epochs left
+    # to publish fresh utilization from. The publisher fires at TrainLoop
+    # log points — multipod_demo logs once per epoch (~4s here).
+    a = start_launcher(store_addr, tmp_path, "podA", epochs=5, step_time=0.7)
+    b = start_launcher(store_addr, tmp_path, "podB", epochs=5, step_time=0.7)
     try:
         def two_up():
             c = read_cluster(client, "mpjob")
@@ -164,6 +170,24 @@ def test_launcher_forms_one_world_and_survives_resize(store_server, tmp_path):
                                      for p in ckpt.iterdir()), \
             "no checkpoint from the 2-pod world"
 
+        # Trainer utilization is published into leased /mpjob/util/
+        # records (TrainLoop auto-installs the publisher under the
+        # launcher) and surfaced by the Collector — the scheduler data
+        # path (reference discovery/register.py:36-40 info field).
+        from edl_tpu.coord.collector import Collector
+        deadline = time.time() + 90
+        util_docs = {}
+        while time.time() < deadline and not util_docs:
+            snap = Collector(client, job_id="mpjob").snapshot()
+            util_docs = {p["pod_id"]: p["utilization"]
+                         for p in snap["job"]["pods"]
+                         if p["utilization"]}
+            time.sleep(0.3)
+        assert util_docs, "no trainer utilization ever published"
+        doc = next(iter(util_docs.values()))
+        assert doc["samples_seen"] > 0 and doc["step"] > 0
+
+        t_kill = time.time()
         os.killpg(os.getpgid(b.pid), signal.SIGKILL)  # pod failure
 
         def resized():
@@ -174,6 +198,23 @@ def test_launcher_forms_one_world_and_survives_resize(store_server, tmp_path):
         while time.time() < deadline and not resized():
             time.sleep(0.3)
         assert resized(), "no stop-resume into 1-pod world"
+
+        # The RESIZED 1-pod world keeps publishing fresh utilization
+        # (records survive the resize). Freshness = publish timestamp
+        # after the kill; samples_seen restores from the checkpoint so
+        # it is NOT monotonic across the resize.
+        deadline = time.time() + 120
+        fresh = None
+        while time.time() < deadline and fresh is None \
+                and a.poll() is None:
+            snap = Collector(client, job_id="mpjob").snapshot()
+            for p in snap["job"]["pods"]:
+                u = p["utilization"]
+                if p["pod_id"] == "podA" and u and u["ts"] > t_kill:
+                    fresh = u
+            time.sleep(0.2)
+        assert fresh is not None, \
+            "resized world published no fresh utilization"
 
         rc = a.wait(timeout=240)
         assert rc == 0, open(tmp_path / "podA.log").read()
