@@ -251,14 +251,15 @@ def expand_outputs(meta: dict, tensors: dict[str, np.ndarray]
                    ) -> dict[str, np.ndarray]:
     """Scatter-expand a compressed response back to dense fp32 logits
     (non-top-k entries get EXPAND_FILL), leaving downstream losses
-    unchanged. Inverse of `compress_outputs`."""
+    unchanged. Inverse of `compress_outputs`; any rank — the classes
+    axis is the LAST one (sequence teachers serve (rows, seq, K))."""
     for name, info in (meta.get("compressed") or {}).items():
         idx = tensors.pop(name + ".idx")
         val = tensors.pop(name + ".val")
-        dense = np.full((idx.shape[0], int(info["classes"])), EXPAND_FILL,
-                        np.float32)
+        dense = np.full(idx.shape[:-1] + (int(info["classes"]),),
+                        EXPAND_FILL, np.float32)
         np.put_along_axis(dense, idx.astype(np.int64),
-                          val.astype(np.float32), axis=1)
+                          val.astype(np.float32), axis=-1)
         tensors[name] = dense
     return tensors
 
@@ -448,9 +449,11 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
                          input_key: str, output_key: str,
                          input_shape: tuple[int, ...] = (32, 32, 3),
                          input_dtype: str = "float32",
-                         serve_topk: int = 0):
+                         serve_topk: int = 0,
+                         local_mesh: str = ""):
     """CLI helper: jitted zoo-model forward with random or restored
-    params. ``serve_topk > 0``: `lax.top_k` runs ON DEVICE and only
+    params; returns ``(predict, compressed_meta)`` (meta None without
+    serve_topk). ``serve_topk > 0``: `lax.top_k` runs ON DEVICE and only
     (idx, val) pairs cross to host — at 1000 classes and K=16 that is a
     62x smaller device->host pull per row, usually the serving
     bottleneck after the feeds themselves."""
@@ -484,11 +487,29 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
         if restored is not None:
             state = restored[0]
 
+    variables = {"params": state.params}
+    if state.batch_stats is not None:
+        variables["batch_stats"] = state.batch_stats
+
+    if local_mesh:
+        # One process drives all local chips: dp-sharded batch over a
+        # local mesh, replicated params (zoo CNNs carry no tp
+        # annotations; transformer-family teachers use the library API —
+        # distill/sharded_teacher.py — with tp-sharded variables).
+        from edl_tpu.distill.sharded_teacher import (parse_local_mesh,
+                                                     sharded_predict_fn)
+        from edl_tpu.parallel import mesh as mesh_lib
+        mesh = parse_local_mesh(local_mesh)
+        placed = mesh_lib.replicate_host_tree(mesh,
+                                              jax.device_get(variables))
+        return sharded_predict_fn(
+            lambda v, x: model.apply(v, x, train=False), placed, mesh,
+            input_key=input_key, output_key=output_key,
+            batch_axes=("dp",), input_dtype=jnp.dtype(input_dtype),
+            serve_topk=serve_topk, classes=num_classes)
+
     @jax.jit
     def forward(images):
-        variables = {"params": state.params}
-        if state.batch_stats is not None:
-            variables["batch_stats"] = state.batch_stats
         logits = model.apply(variables, images, train=False)
         if serve_topk:
             from jax import lax
@@ -510,7 +531,11 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
                 jnp.dtype(input_dtype))
             return {output_key: np.asarray(forward(feed), np.float32)}
 
-    return predict
+    meta = None
+    if serve_topk:
+        meta = {output_key: {"topk": serve_topk,
+                             "classes": num_classes, "values": "<f2"}}
+    return predict, meta
 
 
 def main(argv=None) -> int:
@@ -537,16 +562,15 @@ def main(argv=None) -> int:
                         help="device-side top-k: serve only K "
                              "(idx, fp16 val) pairs per row instead of "
                              "the dense class row")
+    parser.add_argument("--local-mesh", default="",
+                        help="drive ALL local chips from this one "
+                             "process, e.g. 'dp=8' (sharded_teacher.py)")
     args = parser.parse_args(argv)
     shape = tuple(int(x) for x in args.input_shape.split(","))
-    predict = _build_model_predict(args.model, args.num_classes, args.params,
-                                   args.input_key, args.output_key, shape,
-                                   args.input_dtype, args.serve_topk)
-    compressed_meta = None
-    if args.serve_topk:
-        compressed_meta = {args.output_key: {
-            "topk": args.serve_topk, "classes": args.num_classes,
-            "values": "<f2"}}
+    predict, compressed_meta = _build_model_predict(
+        args.model, args.num_classes, args.params,
+        args.input_key, args.output_key, shape,
+        args.input_dtype, args.serve_topk, args.local_mesh)
     server = TeacherServer(predict, port=args.port, host=args.host,
                            max_batch=args.max_batch,
                            max_wait=args.max_wait_ms / 1000.0,

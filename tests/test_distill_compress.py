@@ -180,9 +180,11 @@ class TestOverTheWire:
         """--serve-topk path of the teacher CLI builder: device top-k,
         sparse outputs, values fp16."""
         from edl_tpu.distill.teacher_server import _build_model_predict
-        predict = _build_model_predict("mlp", 10, "", "image", "logits",
-                                       (8, 8, 1), "float32",
-                                       serve_topk=3)
+        predict, meta = _build_model_predict("mlp", 10, "", "image",
+                                             "logits", (8, 8, 1),
+                                             "float32", serve_topk=3)
+        assert meta == {"logits": {"topk": 3, "classes": 10,
+                                   "values": "<f2"}}
         out = predict({"image": np.zeros((2, 8, 8, 1), np.float32)})
         assert set(out) == {"logits.idx", "logits.val"}
         assert out["logits.idx"].shape == (2, 3)

@@ -1,0 +1,138 @@
+"""Multi-chip teacher serving (distill/sharded_teacher.py): a tp x dp
+sharded teacher forward must serve value-identical predictions to the
+single-device one, through the padding path and the real TCP server."""
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.distill.sharded_teacher import (parse_local_mesh,
+                                             sharded_predict_fn)
+from edl_tpu.parallel import mesh as mesh_lib
+from edl_tpu.parallel import sharding as shd
+
+VOCAB, SEQ = 64, 16
+
+
+def _teacher():
+    import jax.numpy as jnp
+
+    from edl_tpu.models.transformer import Transformer, TransformerConfig
+    cfg = TransformerConfig(vocab_size=VOCAB, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_len=SEQ,
+                            dtype=jnp.float32)
+    return Transformer(cfg)
+
+
+def _toks(rows, seed=0):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (rows, SEQ), 0, VOCAB))
+
+
+class TestShardedPredict:
+    def setup_method(self, method):
+        self.mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec(
+            {"dp": 2, "tp": 4}))
+        self.model = _teacher()
+        init_toks = _toks(2)
+        self.variables = shd.init_sharded(
+            lambda: self.model.init(jax.random.PRNGKey(0), init_toks,
+                                    train=False), self.mesh)
+
+    def _apply(self, v, x):
+        return self.model.apply(v, x, train=False)
+
+    def _dense_ref(self, toks):
+        host_vars = jax.device_get(self.variables)
+        return np.asarray(self.model.apply(host_vars, toks, train=False))
+
+    def test_dense_predictions_match_single_device(self):
+        predict, meta = sharded_predict_fn(
+            self._apply, self.variables, self.mesh, input_key="tokens",
+            output_key="logits", batch_axes=("dp",))
+        assert meta is None
+        toks = _toks(4)
+        out = predict({"tokens": toks})["logits"]
+        assert out.shape == (4, SEQ, VOCAB)
+        np.testing.assert_allclose(out, self._dense_ref(toks), atol=2e-5)
+
+    def test_ragged_rows_pad_and_trim(self):
+        """5 rows over dp=2: the pad row must not leak into results."""
+        predict, _ = sharded_predict_fn(
+            self._apply, self.variables, self.mesh, input_key="tokens",
+            output_key="logits", batch_axes=("dp",))
+        toks = _toks(5, seed=3)
+        out = predict({"tokens": toks})["logits"]
+        assert out.shape == (5, SEQ, VOCAB)
+        np.testing.assert_allclose(out, self._dense_ref(toks), atol=2e-5)
+
+    def test_serve_topk_over_vocab_parallel_head(self):
+        """Distributed top-k on the tp-sharded vocab axis: indices/values
+        must match the dense single-device top-k."""
+        predict, meta = sharded_predict_fn(
+            self._apply, self.variables, self.mesh, input_key="tokens",
+            output_key="logits", batch_axes=("dp",), serve_topk=4,
+            classes=VOCAB)
+        assert meta == {"logits": {"topk": 4, "classes": VOCAB,
+                                   "values": "<f2"}}
+        toks = _toks(2, seed=5)
+        out = predict({"tokens": toks})
+        idx, val = out["logits.idx"], out["logits.val"]
+        assert idx.shape == (2, SEQ, 4) and val.dtype == np.float16
+        ref = self._dense_ref(toks)
+        ref_idx = np.argsort(-ref, axis=-1)[..., :4]
+        np.testing.assert_array_equal(idx, ref_idx)
+        np.testing.assert_allclose(
+            val.astype(np.float32),
+            np.take_along_axis(ref, ref_idx, axis=-1), atol=2e-3)
+
+    def test_topk_requires_classes(self):
+        with pytest.raises(ValueError, match="classes"):
+            sharded_predict_fn(self._apply, self.variables, self.mesh,
+                               serve_topk=4)
+
+    def test_through_real_tcp_server(self):
+        """Full path: sharded predict behind TeacherServer, sparse
+        TeacherClient consumes idx/val."""
+        from edl_tpu.distill.teacher_server import (TeacherClient,
+                                                    TeacherServer)
+        predict, meta = sharded_predict_fn(
+            self._apply, self.variables, self.mesh, input_key="tokens",
+            output_key="logits", batch_axes=("dp",), serve_topk=4,
+            classes=VOCAB)
+        with TeacherServer(predict, host="127.0.0.1",
+                           compressed_meta=meta) as srv:
+            c = TeacherClient(f"127.0.0.1:{srv.port}", expand=False)
+            out = c.predict({"tokens": _toks(2, seed=7)})
+            assert out["logits.idx"].shape == (2, SEQ, 4)
+            c.close()
+            # a DEFAULT client must scatter-expand the rank-3 sparse
+            # response transparently (regression: expand_outputs was
+            # 2-D-only and crashed on sequence teachers)
+            dense_c = TeacherClient(f"127.0.0.1:{srv.port}")
+            toks = _toks(2, seed=7)
+            dense = dense_c.predict({"tokens": toks})["logits"]
+            assert dense.shape == (2, SEQ, VOCAB)
+            ref = self._dense_ref(toks)
+            ref_idx = np.argsort(-ref, axis=-1)[..., :4]
+            np.testing.assert_allclose(
+                np.take_along_axis(dense, ref_idx, axis=-1),
+                np.take_along_axis(ref, ref_idx, axis=-1), atol=2e-3)
+            dense_c.close()
+
+
+def test_parse_local_mesh():
+    mesh = parse_local_mesh("dp=4, tp=2")
+    assert dict(mesh.shape) == {"dp": 4, "tp": 2}
+
+
+def test_cli_local_mesh_builder_serves_dp_sharded():
+    """The teacher CLI's --local-mesh flavor: zoo model, replicated
+    params, dp-sharded batch over all local devices."""
+    from edl_tpu.distill.teacher_server import _build_model_predict
+    predict, meta = _build_model_predict("mlp", 10, "", "image", "logits",
+                                         (8, 8, 1), "float32",
+                                         serve_topk=0, local_mesh="dp=8")
+    assert meta is None
+    out = predict({"image": np.zeros((6, 8, 8, 1), np.float32)})
+    assert out["logits"].shape == (6, 10)
