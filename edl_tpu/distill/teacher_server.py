@@ -450,7 +450,8 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
                          input_shape: tuple[int, ...] = (32, 32, 3),
                          input_dtype: str = "float32",
                          serve_topk: int = 0,
-                         local_mesh: str = ""):
+                         local_mesh: str = "",
+                         input_normalize: str = ""):
     """CLI helper: jitted zoo-model forward with random or restored
     params; returns ``(predict, compressed_meta)`` (meta None without
     serve_topk). ``serve_topk > 0``: `lax.top_k` runs ON DEVICE and only
@@ -483,13 +484,32 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
             mgr = CheckpointManager(local, remote=params_path)
         else:
             mgr = CheckpointManager(rest if scheme == "file" else params_path)
-        restored = mgr.restore(state)
+        # Structure-free: the trainer's checkpoint carries ITS optimizer
+        # state (momentum/wd chains) which the serving process neither
+        # has nor wants — take only the model sub-trees.
+        restored = mgr.restore_raw()
         if restored is not None:
-            state = restored[0]
+            raw = restored[0]
+            state = state.replace(params=raw["params"],
+                                  batch_stats=raw.get("batch_stats")
+                                  or state.batch_stats)
+            log.info("teacher params restored from %s (epoch=%d)",
+                     params_path, restored[1].epoch)
 
     variables = {"params": state.params}
     if state.batch_stats is not None:
         variables["batch_stats"] = state.batch_stats
+
+    # On-device pixel normalization matching what the model was TRAINED
+    # with: distill students on the JPEG plane ship raw uint8 feeds, so
+    # a teacher trained on normalized inputs must normalize server-side
+    # or its logits are out-of-distribution garbage.
+    from edl_tpu.train.classification import normalize_image
+    norm = input_normalize or None
+    base_apply = model.apply
+
+    def apply_with_norm(v, x, **kw):
+        return base_apply(v, normalize_image(x, norm), **kw)
 
     if local_mesh:
         # One process drives all local chips: dp-sharded batch over a
@@ -503,14 +523,14 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
         placed = mesh_lib.replicate_host_tree(mesh,
                                               jax.device_get(variables))
         return sharded_predict_fn(
-            lambda v, x: model.apply(v, x, train=False), placed, mesh,
+            lambda v, x: apply_with_norm(v, x, train=False), placed, mesh,
             input_key=input_key, output_key=output_key,
             batch_axes=("dp",), input_dtype=jnp.dtype(input_dtype),
             serve_topk=serve_topk, classes=num_classes)
 
     @jax.jit
     def forward(images):
-        logits = model.apply(variables, images, train=False)
+        logits = apply_with_norm(variables, images, train=False)
         if serve_topk:
             from jax import lax
             val, idx = lax.top_k(logits.astype(jnp.float32), serve_topk)
@@ -556,6 +576,12 @@ def main(argv=None) -> int:
                         help="per-sample input shape, e.g. 28,28,1")
     parser.add_argument("--input-dtype", default="float32",
                         help="float32 for images, int32 for token ids")
+    parser.add_argument("--input-normalize", default="",
+                        choices=("", "imagenet", "unit"),
+                        help="on-device pixel normalization of feeds "
+                             "(MUST match the teacher's training "
+                             "preprocessing when students ship raw "
+                             "uint8, e.g. the JPEG plane)")
     parser.add_argument("--max-batch", type=int, default=64)
     parser.add_argument("--max-wait-ms", type=float, default=2.0)
     parser.add_argument("--serve-topk", type=int, default=0,
@@ -570,7 +596,8 @@ def main(argv=None) -> int:
     predict, compressed_meta = _build_model_predict(
         args.model, args.num_classes, args.params,
         args.input_key, args.output_key, shape,
-        args.input_dtype, args.serve_topk, args.local_mesh)
+        args.input_dtype, args.serve_topk, args.local_mesh,
+        args.input_normalize)
     server = TeacherServer(predict, port=args.port, host=args.host,
                            max_batch=args.max_batch,
                            max_wait=args.max_wait_ms / 1000.0,

@@ -87,7 +87,11 @@ def make_synthetic_shards(data_dir: str, n_files: int, rows: int,
             label = np.where(flip, (label + shift) % num_classes,
                              label).astype(np.int32)
         name = "val.npz" if i == n_files else f"train-{i:04d}.npz"
-        np.savez(os.path.join(data_dir, name), image=img, label=label)
+        # float16 on disk/wire: half the host->device bytes of fp32 (the
+        # binding cost of 224px float shards), zero task fidelity loss
+        # (unit-variance noise), and the model casts to its own dtype
+        np.savez(os.path.join(data_dir, name),
+                 image=img.astype(np.float16), label=label)
 
 
 def build_schedule(args, steps_per_epoch: int, world: int) -> optax.Schedule:
@@ -131,6 +135,11 @@ def main(argv=None) -> int:
                         help="generate N train shards (+1 val) first "
                              "(jpeg format: N random JPEGs + train.txt)")
     parser.add_argument("--rows-per-file", type=int, default=1024)
+    parser.add_argument("--synthetic-signal", type=float, default=0.7,
+                        help="template amplitude of the synthetic data: "
+                             "lower = harder task (small-subset students "
+                             "stay below the ceiling — the operating "
+                             "point the distill-quality clause needs)")
     parser.add_argument("--synthetic-label-noise", type=float, default=0.0,
                         help="fraction of synthetic labels flipped (pins "
                              "the val accuracy ceiling at ~1-x; see "
@@ -175,6 +184,21 @@ def main(argv=None) -> int:
     parser.add_argument("--rotate", action="store_true",
                         help="jpeg mode: +-10 degree random rotation before "
                              "the crop (reference --rotate, img_tool.py)")
+    parser.add_argument("--teachers", default="",
+                        help="distill mode: comma-joined teacher_server "
+                             "endpoints; the loss becomes temperature-KD "
+                             "against served logits (reference "
+                             "train_with_fleet.py soft-label path)")
+    parser.add_argument("--distill-temperature", type=float, default=2.0)
+    parser.add_argument("--distill-hard-weight", type=float, default=0.0,
+                        help="0 = pure soft labels (the reference's "
+                             "distill recipe); >0 mixes hard-label CE")
+    parser.add_argument("--distill-topk", type=int, default=0,
+                        help="negotiate the compressed teacher wire and "
+                             "train on sparse top-K targets")
+    parser.add_argument("--distill-predict-key", default="logits",
+                        help="teacher fetch name (teacher_server "
+                             "--output-key)")
     parser.add_argument("--ckpt-dir", default="")
     parser.add_argument("--benchmark-log", default="")
     parser.add_argument("--profile", default="",
@@ -216,6 +240,7 @@ def main(argv=None) -> int:
             make_synthetic_shards(args.data_dir, args.make_synthetic,
                                   args.rows_per_file, args.image_size,
                                   args.num_classes, args.seed,
+                                  signal=args.synthetic_signal,
                                   label_noise=args.synthetic_label_noise)
     if args.make_synthetic and jax.process_count() > 1:
         # non-writers must not listdir a half-written data dir
@@ -288,10 +313,45 @@ def main(argv=None) -> int:
             optax.sgd(schedule, momentum=args.momentum, nesterov=False))
     state = create_state(model, jax.random.PRNGKey(args.seed),
                          (1, args.image_size, args.image_size, 3), tx)
-    step = make_classification_step(args.num_classes,
-                                    smoothing=args.label_smoothing,
-                                    mixup_alpha=args.mixup_alpha,
-                                    seed=args.seed, normalize=normalize)
+    distill_reader = None
+    if args.teachers:
+        from edl_tpu.distill.reader import DistillReader
+        from edl_tpu.train.classification import (make_distill_step,
+                                                  make_sparse_distill_step)
+        if args.mixup_alpha > 0:
+            raise SystemExit("--mixup-alpha is not supported with "
+                             "--teachers (mixed pixels would be sent to "
+                             "a teacher that expects clean inputs)")
+        if normalize is not None:
+            # The student normalizes ON DEVICE; the teacher receives the
+            # RAW wire feeds and must apply the SAME preprocessing.
+            log.warning(
+                "distill on the JPEG plane ships raw uint8 feeds: start "
+                "the teacher with --input-normalize %s (a mismatched "
+                "teacher emits out-of-distribution logits)", normalize)
+        kd_kw = dict(temperature=args.distill_temperature,
+                     hard_weight=args.distill_hard_weight,
+                     smoothing=args.label_smoothing,
+                     predict_key=args.distill_predict_key,
+                     normalize=normalize)
+        step = (make_sparse_distill_step(args.num_classes, **kd_kw)
+                if args.distill_topk
+                else make_distill_step(args.num_classes, **kd_kw))
+        # ONE reader reused across epochs: data_fn retargets its source
+        # at the current epoch (seed-per-pass order preserved)
+        distill_epoch = [0]
+        distill_reader = DistillReader(
+            lambda: loader.epoch(distill_epoch[0]), feeds=("image",),
+            predicts=(args.distill_predict_key,),
+            teachers=[t for t in args.teachers.split(",") if t],
+            compress_topk=args.distill_topk,
+            sparse_predicts=bool(args.distill_topk))
+    else:
+        step = make_classification_step(args.num_classes,
+                                        smoothing=args.label_smoothing,
+                                        mixup_alpha=args.mixup_alpha,
+                                        seed=args.seed,
+                                        normalize=normalize)
     eval_step = make_eval_step(normalize=normalize)
 
     # eval_batches: None, or a zero-arg callable yielding {'image',
@@ -358,11 +418,20 @@ def main(argv=None) -> int:
         place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t))
 
     def data_fn(epoch):
-        it = loader.epoch(epoch)
+        if distill_reader is not None:
+            distill_epoch[0] = epoch
+            it = distill_reader()
+        else:
+            it = loader.epoch(epoch)
         return prefetch_to_device(it, data_sharding) \
             if jax.process_count() == 1 else it
 
-    status = loop.run(data_fn)
+    try:
+        status = loop.run(data_fn)
+    finally:
+        # close on the deadman/error path too (discovery client thread)
+        if distill_reader is not None:
+            distill_reader.close()
     if rank == 0 and args.benchmark_log:
         blog.write(args.benchmark_log, rank)
     final = blog.finalize().get("final", {})

@@ -407,6 +407,49 @@ class CheckpointManager:
 
     # -- load --------------------------------------------------------------
 
+    def restore_raw(self, version: int | None = None
+                    ) -> tuple[dict, TrainStatus] | None:
+        """Structure-FREE restore of a replicated checkpoint: the raw
+        nested state dict (``{'params': ..., 'batch_stats': ..., ...}``)
+        with no target pytree. For consumers that only want a sub-tree —
+        a teacher server restoring params saved by a trainer whose
+        optimizer state it neither has nor wants (serialization
+        `from_bytes` would reject the opt_state structure mismatch)."""
+        if version is None:
+            version = self.latest_version()
+            if self.remote is not None:
+                # Same prefer-remote-when-newer rule as restore(): a
+                # teacher pod restarted in place must not serve stale
+                # local params while the trainer's mirror moved on.
+                from edl_tpu.utils import fs
+                try:
+                    remote_latest = fs.remote_latest_version(self.remote)
+                except fs.EdlFsError as exc:
+                    log.warning("mirror %s unreachable for restore_raw: "
+                                "%s", self.remote, exc)
+                    remote_latest = None
+                if remote_latest is not None and (
+                        version is None or remote_latest > version):
+                    version = fs.fetch_latest_checkpoint(self.remote,
+                                                         self.directory)
+        if version is None:
+            return None
+        if (not os.path.isdir(self._path(version))
+                and self.remote is not None):
+            from edl_tpu.utils import fs
+            fs.fetch_latest_checkpoint(self.remote, self.directory,
+                                       version=version)
+        path = self._path(version)
+        if sc.is_sharded_dir(path):
+            raise ValueError(
+                f"{path} is a sharded checkpoint; restore_raw serves the "
+                "replicated msgpack format (pass a target to restore())")
+        with open(os.path.join(path, "state.msgpack"), "rb") as f:
+            raw = serialization.msgpack_restore(f.read())
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        return raw, TrainStatus.from_dict(meta["status"])
+
     def restore(self, target: Any, version: int | None = None
                 ) -> tuple[Any, TrainStatus] | None:
         """Restore into the structure of ``target``; None if no checkpoint.

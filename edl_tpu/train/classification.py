@@ -151,21 +151,21 @@ def make_classification_step(num_classes: int, *, smoothing: float = 0.0,
 
 def _make_kd_step(kd_loss: Callable, num_classes: int, *,
                   hard_weight: float, smoothing: float, donate: bool,
-                  input_key: str) -> Callable:
+                  input_key: str, normalize: str | None = None) -> Callable:
     """Shared KD step plumbing: `kd_loss(logits, batch) -> loss` is the
     only thing that differs between the dense and sparse variants."""
 
     def loss_fn(state: TrainState, params: Any, batch: dict):
+        images = normalize_image(batch[input_key], normalize)
         variables = {"params": params}
         if state.batch_stats is not None:
             variables["batch_stats"] = state.batch_stats
             logits, mutated = state.apply_fn(
-                variables, batch[input_key], train=True,
+                variables, images, train=True,
                 mutable=["batch_stats"])
             new_stats = mutated["batch_stats"]
         else:
-            logits = state.apply_fn(variables, batch[input_key],
-                                    train=True)
+            logits = state.apply_fn(variables, images, train=True)
             new_stats = None
         loss = kd_loss(logits, batch)
         if hard_weight > 0.0:
@@ -182,19 +182,20 @@ def _make_kd_step(kd_loss: Callable, num_classes: int, *,
 
 def make_distill_step(num_classes: int, *, temperature: float = 1.0,
                       hard_weight: float = 0.0, smoothing: float = 0.0,
-                      donate: bool = True,
-                      input_key: str = "image") -> Callable:
-    """Step for {input_key,'label','teacher_logits'} batches: KD loss
+                      donate: bool = True, input_key: str = "image",
+                      predict_key: str = "teacher_logits",
+                      normalize: str | None = None) -> Callable:
+    """Step for {input_key,'label',predict_key} batches: KD loss
     (+ optional hard-label CE mix). The student-side consumer of the
     DistillReader pipeline (reference distill/resnet train_with_fleet.py
     soft-label path)."""
 
     def kd_loss(logits, batch):
-        return distill_kl(logits, batch["teacher_logits"], temperature)
+        return distill_kl(logits, batch[predict_key], temperature)
 
     return _make_kd_step(kd_loss, num_classes, hard_weight=hard_weight,
                          smoothing=smoothing, donate=donate,
-                         input_key=input_key)
+                         input_key=input_key, normalize=normalize)
 
 
 def sparse_distill_kl(student_logits: jax.Array, teacher_idx: jax.Array,
@@ -219,8 +220,8 @@ def make_sparse_distill_step(num_classes: int, *, temperature: float = 1.0,
                              hard_weight: float = 0.0,
                              smoothing: float = 0.0, donate: bool = True,
                              input_key: str = "image",
-                             predict_key: str = "teacher_logits"
-                             ) -> Callable:
+                             predict_key: str = "teacher_logits",
+                             normalize: str | None = None) -> Callable:
     """`make_distill_step` for sparse teacher targets: batches carry
     ``{predict_key}.idx`` / ``{predict_key}.val`` (DistillReader with
     ``compress_topk=K, sparse_predicts=True``) instead of dense logits.
@@ -232,7 +233,7 @@ def make_sparse_distill_step(num_classes: int, *, temperature: float = 1.0,
 
     return _make_kd_step(kd_loss, num_classes, hard_weight=hard_weight,
                          smoothing=smoothing, donate=donate,
-                         input_key=input_key)
+                         input_key=input_key, normalize=normalize)
 
 
 def make_eval_step(input_key: str = "image",

@@ -63,6 +63,39 @@ def test_crashed_partial_write_invisible(tmp_path):
     assert not orphan.exists()
 
 
+def test_restore_raw_ignores_optimizer_structure(tmp_path):
+    """A serving process restores params from a trainer's checkpoint
+    without knowing (or matching) the trainer's optimizer chain — the
+    structure-bound restore() rejects the opt_state mismatch
+    (regression: teacher_server --params died on ValueError)."""
+    import jax
+    model_params = {"w": jnp.ones((4,)) * 3.0}
+    trainer_state = TrainState.create(
+        apply_fn=lambda *a: None, params=model_params,
+        tx=optax.chain(optax.add_decayed_weights(1e-4),
+                       optax.sgd(0.1, momentum=0.9)))
+    mgr = CheckpointManager(str(tmp_path), process_index=0)
+    mgr.save(trainer_state, TrainStatus(epoch=5, step=50, world_size=2))
+
+    # a different-optimizer target makes restore() raise...
+    server_state = TrainState.create(apply_fn=lambda *a: None,
+                                     params={"w": jnp.zeros((4,))},
+                                     tx=optax.identity())
+    with pytest.raises(Exception):
+        mgr.restore(server_state)
+    # ...restore_raw serves the params regardless
+    raw, status = mgr.restore_raw()
+    assert status.epoch == 5
+    assert float(jax.tree.leaves(raw["params"]["w"])[0][0]) == 3.0
+    server_state = server_state.replace(params=raw["params"])
+    assert float(server_state.params["w"][0]) == 3.0
+
+
+def test_restore_raw_none_when_empty(tmp_path):
+    assert CheckpointManager(str(tmp_path),
+                             process_index=0).restore_raw() is None
+
+
 def test_corrupt_meta_raises(tmp_path):
     mgr = CheckpointManager(str(tmp_path), process_index=0)
     mgr.save(_state(1.0), TrainStatus(epoch=0))

@@ -1,0 +1,235 @@
+"""Distill QUALITY at flagship scale on the real chip.
+
+The reference's headline is not only throughput: its ResNet50_vd student
+reaches acc1 79.0 distilled vs 77.1 trained alone on the same data
+(/root/reference/README.md:70-72). This tool measures OUR analogue of
+that claim with the real serving stack:
+
+  teacher   = ResNet50_vd trained on the FULL synthetic-ImageNet shards
+              (224 px, low template signal so subset students sit below
+              the ceiling);
+  alone     = ResNet50_vd student trained on a SUBSET of the shards with
+              hard labels only;
+  distilled = the SAME student/subset/steps/LR, but the loss is
+              temperature-KD against the teacher's logits served over
+              the real TCP stack (teacher_server CLI + DistillReader
+              inside examples/imagenet_train --teachers).
+
+distill_acc1_delta = distilled_acc1 - alone_acc1. Matched budget: both
+students run identical epochs/LR/batch on identical data; the ONLY
+difference is the loss target. bench.py surfaces the recorded delta in
+BENCH extras (reads the artifact this writes).
+
+Usage (TPU host):  python tools/distill_quality_tpu.py \
+                       --out DISTILL_QUALITY_r5.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+TRAINER = "edl_tpu.examples.imagenet_train"
+
+
+def run(cmd, env=None, timeout=2400, log_path=None):
+    log = open(log_path, "wb") if log_path else None
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout,
+                              stdout=log or subprocess.PIPE,
+                              stderr=subprocess.STDOUT, cwd=REPO)
+    finally:
+        if log:
+            log.close()
+    if proc.returncode != 0:
+        tail = ""
+        if log_path and os.path.exists(log_path):
+            with open(log_path, "rb") as f:
+                tail = f.read()[-4000:].decode(errors="replace")
+        raise SystemExit(f"command failed ({proc.returncode}): "
+                         f"{' '.join(cmd)}\n{tail}")
+    return proc
+
+
+def train(a, data_dir, work, tag, epochs, *, ckpt=None, teachers="",
+          topk=0, seed=0):
+    blog = os.path.join(work, f"blog-{tag}")
+    shutil.rmtree(blog, ignore_errors=True)
+    cmd = [sys.executable, "-m", TRAINER, "--data-dir", data_dir,
+           "--model", a.model, "--num-classes", str(a.classes),
+           "--image-size", str(a.image_size), "--epochs", str(epochs),
+           "--batch-size", str(a.batch_size), "--warmup-epochs", "1",
+           "--lr-strategy", "cosine", "--lr", str(a.lr), "--no-augment",
+           "--label-smoothing", "0", "--bf16", "--seed", str(seed),
+           "--benchmark-log", blog]
+    if ckpt:
+        cmd += ["--ckpt-dir", ckpt]
+    if teachers:
+        cmd += ["--teachers", teachers,
+                "--distill-temperature", str(a.temperature),
+                "--distill-hard-weight", str(a.hard_weight)]
+        if topk:
+            cmd += ["--distill-topk", str(topk)]
+    run(cmd, timeout=a.phase_timeout,
+        log_path=os.path.join(work, f"{tag}.log"))
+    with open(os.path.join(blog, "log_0.json")) as f:
+        return json.load(f)["final"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tools/distill_quality_tpu.py")
+    p.add_argument("--out", default="DISTILL_QUALITY_r5.json")
+    p.add_argument("--workdir", default="/tmp/edl_distill_quality")
+    p.add_argument("--model", default="ResNet50_vd")
+    p.add_argument("--classes", type=int, default=8)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--shards", type=int, default=8)
+    p.add_argument("--rows-per-file", type=int, default=256)
+    p.add_argument("--student-shards", type=int, default=2,
+                   help="the students' subset (the teacher's knowledge "
+                        "of the remaining shards is what distillation "
+                        "transfers — the reference's teacher was "
+                        "likewise trained far beyond its students)")
+    p.add_argument("--signal", type=float, default=0.45,
+                   help="template amplitude: low enough that the "
+                        "subset-trained hard-label student sits well "
+                        "below the teacher (224px template tasks "
+                        "saturate at the 0.7 default; measured on v5e: "
+                        "0.45 + lr 0.02 learns steadily, 0.5 + lr 0.05 "
+                        "is unstable, <=0.35 is stuck at chance)")
+    p.add_argument("--teacher-epochs", type=int, default=12)
+    p.add_argument("--student-epochs", type=int, default=12)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.02)
+    p.add_argument("--temperature", type=float, default=2.0)
+    p.add_argument("--hard-weight", type=float, default=0.0)
+    p.add_argument("--serve-topk", type=int, default=0,
+                   help=">0: ALSO run the compressed-wire distilled "
+                        "student and record its delta")
+    p.add_argument("--phase-timeout", type=int, default=2400)
+    p.add_argument("--reuse-teacher", action="store_true",
+                   help="skip teacher training when its checkpoint and "
+                        "blog already exist in the workdir (iteration "
+                        "aid; the recorded teacher_acc1 comes from the "
+                        "reused run)")
+    a = p.parse_args(argv)
+
+    work = a.workdir
+    os.makedirs(work, exist_ok=True)
+    t0 = time.time()
+
+    # -- data: full shards + a subset dir sharing the SAME val shard ----
+    full = os.path.join(work, "data_full")
+    marker = os.path.join(full, ".recipe")
+    want = (f"signal={a.signal} classes={a.classes} shards={a.shards} "
+            f"rows={a.rows_per_file} size={a.image_size}")
+    if not os.path.exists(marker) or open(marker).read().strip() != want:
+        shutil.rmtree(full, ignore_errors=True)
+        run([sys.executable, "-m", TRAINER, "--data-dir", full,
+             "--make-synthetic", str(a.shards),
+             "--rows-per-file", str(a.rows_per_file),
+             "--synthetic-signal", str(a.signal),
+             "--model", a.model, "--num-classes", str(a.classes),
+             "--image-size", str(a.image_size), "--epochs", "0",
+             "--batch-size", str(a.batch_size)],
+            log_path=os.path.join(work, "datagen.log"))
+        with open(marker, "w") as f:
+            f.write(want)
+    sub = os.path.join(work, "data_subset")
+    shutil.rmtree(sub, ignore_errors=True)
+    os.makedirs(sub)
+    shards = sorted(f for f in os.listdir(full) if f.startswith("train-"))
+    for f in shards[: a.student_shards] + ["val.npz"]:
+        os.link(os.path.join(full, f), os.path.join(sub, f))
+
+    # -- teacher: full data, checkpointed -------------------------------
+    ckpt = os.path.join(work, "teacher_ckpt")
+    teacher_blog = os.path.join(work, "blog-teacher", "log_0.json")
+    if a.reuse_teacher and os.path.isdir(ckpt) \
+            and os.path.exists(teacher_blog):
+        with open(teacher_blog) as f:
+            teacher = json.load(f)["final"]
+    else:
+        shutil.rmtree(ckpt, ignore_errors=True)
+        teacher = train(a, full, work, "teacher", a.teacher_epochs,
+                        ckpt=ckpt)
+
+    # -- student baseline: subset, hard labels only ---------------------
+    alone = train(a, sub, work, "alone", a.student_epochs, seed=1)
+
+    # -- distilled student: same subset/budget, served teacher logits ---
+    from edl_tpu.utils import net
+    port = net.free_port()
+    tlog = os.path.join(work, "teacher_server.log")
+    tsrv = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.distill.teacher_server",
+         "--model", a.model, "--num-classes", str(a.classes),
+         "--params", ckpt, "--host", "127.0.0.1", "--port", str(port),
+         "--input-shape", f"{a.image_size},{a.image_size},3",
+         "--max-batch", "64"]
+        + (["--serve-topk", str(a.serve_topk)] if a.serve_topk else []),
+        stdout=open(tlog, "wb"), stderr=subprocess.STDOUT, cwd=REPO)
+    try:
+        # the teacher restores params + binds before listening; FAIL
+        # here (with its log) rather than letting the student's deadman
+        # report a confusing connect-refused 60s later
+        from edl_tpu.utils.net import is_endpoint_alive
+        deadline = time.time() + 180
+        while time.time() < deadline and not is_endpoint_alive(
+                f"127.0.0.1:{port}"):
+            if tsrv.poll() is not None:
+                break
+            time.sleep(0.5)
+        if not is_endpoint_alive(f"127.0.0.1:{port}"):
+            with open(tlog, "rb") as f:
+                tail = f.read()[-3000:].decode(errors="replace")
+            raise SystemExit(f"teacher server never came up:\n{tail}")
+        distilled = train(a, sub, work, "distilled", a.student_epochs,
+                          teachers=f"127.0.0.1:{port}",
+                          topk=a.serve_topk, seed=1)
+    finally:
+        tsrv.kill()
+
+    delta = distilled["acc1"] - alone["acc1"]
+    report = {
+        "clause": "same student/subset/steps/LR; only the loss target "
+                  "differs (hard labels vs served teacher logits) — the "
+                  "reference's acc1 77.1->79.0 analogue "
+                  "(/root/reference/README.md:70-72)",
+        "teacher_acc1": teacher["acc1"],
+        "alone_acc1": alone["acc1"],
+        "distilled_acc1": distilled["acc1"],
+        "distill_acc1_delta": round(delta, 5),
+        "pass": delta > 0.0,
+        "config": {"model": a.model, "image_size": a.image_size,
+                   "classes": a.classes, "signal": a.signal,
+                   "teacher_samples": a.shards * a.rows_per_file,
+                   "student_samples": a.student_shards * a.rows_per_file,
+                   "teacher_epochs": a.teacher_epochs,
+                   "student_epochs": a.student_epochs,
+                   "batch_size": a.batch_size, "lr": a.lr,
+                   "temperature": a.temperature,
+                   "hard_weight": a.hard_weight,
+                   "serve_topk": a.serve_topk,
+                   "wire": "TCP teacher_server + DistillReader inside "
+                           "examples/imagenet_train --teachers"},
+        "wall_s": round(time.time() - t0, 1),
+    }
+    with open(a.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({k: report[k] for k in
+                      ("teacher_acc1", "alone_acc1", "distilled_acc1",
+                       "distill_acc1_delta", "pass")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
