@@ -105,6 +105,15 @@ class TaskMaster:
         self.lease_timeout = lease_timeout
         self.max_failures = max_failures
         self._clock = clock
+        # Claim-candidate cache: the leftover todo keys of the last full
+        # scan. A claim is then one get + one CAS (amortized) instead of
+        # a whole-prefix scan + parse per claim — the difference between
+        # file-shard granularity (hundreds of tasks) and record-range
+        # granularity (10^5+, file_list_specs with records_per_task).
+        # Staleness is harmless: every claim re-reads the record and the
+        # CAS guards the transition.
+        self._cache_epoch: int | None = None
+        self._todo_keys: list[str] = []
 
     # -- keys ---------------------------------------------------------------
 
@@ -146,8 +155,10 @@ class TaskMaster:
 
     # -- dispensing ---------------------------------------------------------
 
-    def _claim(self, rec, epoch: int, failures: int) -> Task | None:
-        data = json.loads(rec.value)
+    def _claim(self, rec, epoch: int, failures: int,
+               data: dict | None = None) -> Task | None:
+        if data is None:
+            data = json.loads(rec.value)
         new_raw = _task_record(data["spec"], "pending", self.owner,
                                self._clock() + self.lease_timeout, failures)
         if self.store.compare_and_swap(rec.key, rec.value, new_raw):
@@ -167,6 +178,29 @@ class TaskMaster:
         epoch = self.current_epoch()
         if epoch is None:
             raise EdlTaskError("no epoch installed")
+        if self._cache_epoch != epoch:
+            self._cache_epoch, self._todo_keys = epoch, []
+        # Fast path: drain cached candidates (re-read + CAS per try).
+        # Bounded misses: a mostly-stale cache (another consumer drained
+        # the epoch while we stalled) must not turn into O(n) sequential
+        # round-trips — after a run of misses, drop it and bulk-rescan.
+        misses = 0
+        while self._todo_keys and misses < 16:
+            rec = self.store.get(self._todo_keys.pop())
+            if rec is None:
+                misses += 1
+                continue
+            data = json.loads(rec.value)
+            if data["state"] != "todo":
+                misses += 1
+                continue
+            task = self._claim(rec, epoch, data["failures"], data)
+            if task is not None:
+                return task
+            misses += 1
+        self._todo_keys = []
+        # Cache dry: full scan (also the only place expired pendings and
+        # epoch completion are observed — bounded-staleness by design).
         recs, _ = self.store.get_prefix(self._task_prefix(epoch))
         now = self._clock()
         todo, expired = [], []
@@ -179,9 +213,10 @@ class TaskMaster:
         # Contending consumers spread over the claimable set instead of
         # all CAS-racing the first record.
         random.shuffle(todo)
-        for rec, data in todo:
+        for i, (rec, data) in enumerate(todo):
             task = self._claim(rec, epoch, data["failures"])
             if task is not None:
+                self._todo_keys = [r.key for r, _ in todo[i + 1:]]
                 return task
         for rec, data in expired:
             failures = data["failures"] + 1

@@ -150,6 +150,44 @@ def test_contending_consumers_get_disjoint_tasks(store):
     assert sorted(got) == sorted(s["file"] for s in specs(n))  # exactly once
 
 
+def test_claims_amortize_prefix_scans(store):
+    """The todo-candidate cache: N sequential claims must not do N full
+    prefix scans (O(all tasks) per claim was VERDICT r4 weak #7 — it
+    binds at record-range granularity, 10^5+ tasks)."""
+    n = 50
+    m = master(store, "pod0")
+    m.init_epoch(0, specs(n))
+    scans = {"n": 0}
+    orig = store.get_prefix
+
+    def counting(prefix):
+        if "/task/" in prefix:
+            scans["n"] += 1
+        return orig(prefix)
+
+    store.get_prefix = counting
+    claimed = 0
+    while True:
+        t = m.get_task()
+        if t is None:
+            break
+        m.finished(t)
+        claimed += 1
+    assert claimed == n
+    # one populating scan + the final empty-confirming scan(s); NOT one
+    # per claim
+    assert scans["n"] <= 3, scans["n"]
+
+
+def test_cache_invalidated_on_new_epoch(store):
+    m = master(store, "pod0")
+    m.init_epoch(0, specs(4))
+    assert m.get_task() is not None  # populates the epoch-0 cache
+    m.init_epoch(1, specs(2))
+    t = m.get_task()
+    assert t is not None and t.epoch == 1  # stale keys never served
+
+
 def test_file_list_specs_record_ranges():
     assert file_list_specs(["a", "b"]) == [{"file": "a"}, {"file": "b"}]
     ranged = file_list_specs(["a"], records_per_task=4, counts=[10])
