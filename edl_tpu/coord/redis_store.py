@@ -142,9 +142,12 @@ class RedisStore(Store):
             return None
         try:
             doc = json.loads(blob)
+            # non-record values (the !edl: revision/lease bookkeeping
+            # keys parse as bare ints) surface in whole-keyspace scans,
+            # e.g. the Collector's store-health snapshot
             return Record(key=key, value=doc["v"], revision=int(doc["r"]),
                           lease=int(doc.get("l", 0)))
-        except (json.JSONDecodeError, KeyError, ValueError):
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError):
             return None
 
     def get(self, key: str) -> Record | None:

@@ -98,6 +98,24 @@ class TestCollector:
             proc.wait(timeout=5)
 
 
+def test_collector_over_redis_flavor():
+    """The collector's reads (get_prefix/get) are within RedisStore's
+    scope, so the SAME scrape works over the redis discovery flavor."""
+    from edl_tpu.coord.redis_store import RedisStore
+    from edl_tpu.coord.resp import MiniRedis
+    srv = MiniRedis().start()
+    try:
+        store = RedisStore(srv.endpoint)
+        _seed_job(store, job="jr")
+        snap = Collector(store, job_id="jr").snapshot()
+        assert snap["job"]["generation"] == 3
+        assert len(snap["job"]["pods"]) == 2
+        assert snap["store"]["leased_keys"] >= 2
+        store.close()
+    finally:
+        srv.stop()
+
+
 class TestUtilizationPublisher:
     class _Loop:
         class status:

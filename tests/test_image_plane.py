@@ -268,3 +268,42 @@ class TestFlagshipJpegMode:
         blog = json.load(open(tmp_path / "blog" / "log_0.json"))
         assert len(blog["epochs"]) == 2
         assert blog["epochs"][-1]["examples_per_sec"] > 0
+
+    @pytest.mark.slow
+    def test_jpeg_distill_with_normalized_teacher(self, tmp_path):
+        """Distill over the JPEG plane: the student ships RAW uint8
+        feeds, the teacher normalizes server-side (--input-normalize
+        contract — a mismatched teacher would emit out-of-distribution
+        logits). Asserts the teacher really saw uint8 and the run
+        completes."""
+        import json
+
+        import numpy as np
+
+        from edl_tpu.distill.teacher_server import (TeacherServer,
+                                                    _build_model_predict)
+        from edl_tpu.examples.imagenet_train import main
+
+        predict, _ = _build_model_predict(
+            "ResNetTiny", 4, "", "image", "logits", (24, 24, 3),
+            "float32", input_normalize="imagenet")
+        seen = {}
+
+        def spy(feeds):
+            seen["dtype"] = feeds["image"].dtype
+            return predict(feeds)
+
+        data = str(tmp_path / "jpegs")
+        with TeacherServer(spy, host="127.0.0.1") as srv:
+            rc = main(["--data-dir", data, "--data-format", "jpeg",
+                       "--make-synthetic", "64", "--model", "ResNetTiny",
+                       "--num-classes", "4", "--image-size", "24",
+                       "--epochs", "1", "--batch-size", "32",
+                       "--warmup-epochs", "0", "--label-smoothing", "0",
+                       "--lr", "0.02", "--decode-threads", "2",
+                       "--teachers", f"127.0.0.1:{srv.port}",
+                       "--benchmark-log", str(tmp_path / "blog")])
+        assert rc == 0
+        assert seen["dtype"] == np.uint8  # raw wire feeds, as designed
+        blog = json.load(open(tmp_path / "blog" / "log_0.json"))
+        assert len(blog["epochs"]) == 1
