@@ -167,6 +167,13 @@ class RedisStore(Store):
 
     def get_prefix(self, prefix: str) -> tuple[list[Record], int]:
         keys = self._scan(_glob_escape(prefix) + "*")
+        # the !edl: bookkeeping namespace (revision/lease counters and
+        # member sets) is not record data — InMemStore keeps its
+        # equivalents out of the keyspace entirely, so whole-keyspace
+        # scans (e.g. the Collector's store-health tick) must not
+        # surface or MGET it here either
+        if not prefix.startswith("!edl:"):
+            keys = [k for k in keys if not k.startswith("!edl:")]
         recs = []
         if keys:
             blobs = self._client.command("MGET", *keys)
