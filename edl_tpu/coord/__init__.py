@@ -4,6 +4,7 @@ from edl_tpu.coord.lock import DistributedLock, LeaderElection
 from edl_tpu.coord.redis_store import RedisStore, connect_store
 from edl_tpu.coord.registry import ServiceRegistry, ServerMeta
 from edl_tpu.coord.resp import MiniRedis
+from edl_tpu.coord.collector import Collector, UtilizationPublisher
 from edl_tpu.coord.consistent_hash import ConsistentHash
 
 
@@ -30,4 +31,6 @@ __all__ = [
     "ServiceRegistry",
     "ServerMeta",
     "ConsistentHash",
+    "Collector",
+    "UtilizationPublisher",
 ]

@@ -11,12 +11,25 @@ coordination store.
     discovery_client — student-side registration + heartbeat + server cache
     balance          — the pure rebalance math
     reader           — DistillReader: wraps a data reader, appends teacher
-                       predictions (the user-facing API)
+                       predictions (the user-facing API; the reference's
+                       three reader formats via ins=[...] +
+                       set_*_generator)
+    sharded_teacher  — one server process drives ALL local chips (dp/tp
+                       mesh, device-side top-k serving)
+
+The teacher wire supports negotiated top-k+fp16 compression
+(`DistillReader(compress_topk=K)`, expanded transparently;
+`sparse_predicts=True` + train.classification.make_sparse_distill_step
+keeps targets sparse on device).
 """
 
 from edl_tpu.distill.balance import ServiceBalance
-from edl_tpu.distill.reader import DistillReader
-from edl_tpu.distill.teacher_server import TeacherClient, TeacherServer
+from edl_tpu.distill.reader import DistillReader, EdlDistillError
+from edl_tpu.distill.sharded_teacher import sharded_predict_fn
+from edl_tpu.distill.teacher_server import (TeacherClient, TeacherServer,
+                                            compress_outputs,
+                                            expand_outputs)
 
-__all__ = ["ServiceBalance", "DistillReader", "TeacherClient",
-           "TeacherServer"]
+__all__ = ["ServiceBalance", "DistillReader", "EdlDistillError",
+           "TeacherClient", "TeacherServer", "compress_outputs",
+           "expand_outputs", "sharded_predict_fn"]

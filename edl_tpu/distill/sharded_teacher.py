@@ -70,6 +70,12 @@ def sharded_predict_fn(apply_fn, variables, mesh: Mesh, *,
     if serve_topk and classes is None:
         raise ValueError("serve_topk needs `classes` (the dense width) "
                          "for the client-side expansion announcement")
+    if serve_topk and serve_topk > classes:
+        # lax.top_k rejects k > axis size — clamp instead of an opaque
+        # XLA error on the first predict (same guard as the CLI path)
+        log.warning("serve_topk %d > %d classes; clamping", serve_topk,
+                    classes)
+        serve_topk = int(classes)
 
     @jax.jit
     def fwd(variables, x):

@@ -467,6 +467,12 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
 
     factory = zoo.get_model(model_name)
     model = factory(num_classes=num_classes)
+    if serve_topk > num_classes:
+        # lax.top_k rejects k > axis size — clamp instead of crashing
+        # the first predict (a 1000-class default K on a small head)
+        log.warning("--serve-topk %d > %d classes; clamping", serve_topk,
+                    num_classes)
+        serve_topk = num_classes
     # Dense layers bind their kernel to the flattened input size, so init
     # must see the shape that will be served.
     state = create_state(model, jax.random.PRNGKey(0), (1,) + input_shape,

@@ -195,6 +195,17 @@ class TestOverTheWire:
         assert (out["logits.idx"] >= 0).all()
         assert (out["logits.idx"] < 10).all()
 
+    def test_cli_serve_topk_clamped_to_classes(self):
+        """--serve-topk larger than the head must clamp, not crash the
+        first predict (lax.top_k rejects k > axis size)."""
+        from edl_tpu.distill.teacher_server import _build_model_predict
+        predict, meta = _build_model_predict(
+            "mlp", 6, "", "image", "logits", (8, 8, 1), "float32",
+            serve_topk=16)
+        assert meta["logits"]["topk"] == 6  # clamped AND announced
+        out = predict({"image": np.zeros((2, 8, 8, 1), np.float32)})
+        assert out["logits.idx"].shape == (2, 6)
+
     def test_uint8_feeds_ship_unchanged(self):
         seen = {}
 

@@ -91,6 +91,17 @@ class TestShardedPredict:
             sharded_predict_fn(self._apply, self.variables, self.mesh,
                                serve_topk=4)
 
+    def test_topk_clamped_to_classes(self):
+        """K > head width must clamp + announce the clamped K, not die
+        in lax.top_k on the first predict."""
+        predict, meta = sharded_predict_fn(
+            self._apply, self.variables, self.mesh, input_key="tokens",
+            output_key="logits", batch_axes=("dp",),
+            serve_topk=VOCAB + 100, classes=VOCAB)
+        assert meta["logits"]["topk"] == VOCAB
+        out = predict({"tokens": _toks(2)})
+        assert out["logits.idx"].shape == (2, SEQ, VOCAB)
+
     def test_through_real_tcp_server(self):
         """Full path: sharded predict behind TeacherServer, sparse
         TeacherClient consumes idx/val."""
