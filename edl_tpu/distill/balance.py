@@ -21,7 +21,12 @@ Invariants (property-tested in tests/test_balance.py):
       long-lived assignment (the skew-repair pass shifts links off the
       most-loaded servers, so a new teacher is put to work immediately
       instead of waiting for client churn);
-  I5. versions bump iff the client's server set changed.
+  I5. versions bump iff the client's server set changed;
+  I6. utilization is a TIE-BREAK only: among servers with equal link
+      counts the least-busy (registrar-reported ``util``) is preferred,
+      so the idle S mod C servers of an under-subscribed service are the
+      busiest ones — I1-I4 are unaffected by construction (the link
+      count stays the primary key).
 
 Unlike the reference this is a standalone, lock-free-by-construction value
 type: the discovery server owns one instance per service and serializes
@@ -57,6 +62,20 @@ class ServiceBalance:
         self.name = name
         self.servers: tuple[str, ...] = ()
         self.clients: dict[str, ClientLinks] = {}
+        # teacher-reported busy score (registrar stats `util`): ONLY a
+        # tie-break among equal link counts, so I1-I4 are untouched —
+        # when the population leaves servers idle (S mod C) or several
+        # candidates tie, the LEAST-busy teachers get the links
+        self.utilization: dict[str, float] = {}
+
+    def set_utilization(self, util: dict[str, float]) -> None:
+        self.utilization = dict(util)
+
+    def _busy(self, server: str) -> float:
+        # Unknown load is NEUTRAL (0.5), not idle: a non-reporting
+        # teacher must not systematically win ties against one honestly
+        # reporting a small util — it could be saturated for all we know.
+        return self.utilization.get(server, 0.5)
 
     # -- membership --------------------------------------------------------
 
@@ -123,7 +142,8 @@ class ServiceBalance:
                               if load[s] < server_cap and s not in links]
                 if not candidates:
                     break
-                best = min(candidates, key=lambda s: (load[s], s))
+                best = min(candidates,
+                           key=lambda s: (load[s], self._busy(s), s))
                 links.append(best)
                 load[best] += 1
 
@@ -134,8 +154,10 @@ class ServiceBalance:
         # least-loaded server until the gap closes to <= 1.
         if self.servers:
             while True:
-                lo = min(self.servers, key=lambda s: (load[s], s))
-                hi = max(self.servers, key=lambda s: (load[s], s))
+                lo = min(self.servers,
+                         key=lambda s: (load[s], self._busy(s), s))
+                hi = max(self.servers,
+                         key=lambda s: (load[s], self._busy(s), s))
                 if load[hi] - load[lo] <= 1:
                     break
                 moved = False

@@ -26,6 +26,7 @@ CLI:
 from __future__ import annotations
 
 import argparse
+import json
 import socket
 import socketserver
 import threading
@@ -84,15 +85,29 @@ class BalanceTable:
 
     # -- client RPCs --------------------------------------------------------
 
+    def _apply_registry(self, svc: ServiceBalance, metas) -> None:
+        """Install a registry snapshot: servers AND busy scores, then
+        rebalance — one helper so register() and tick() cannot drift.
+        The busy tie-break must be live from the FIRST assignment:
+        phase-1 keep preserves whatever links a rebalance creates, so a
+        util-blind initial fill would freeze name-order links past
+        every later tick."""
+        svc.set_servers([m.server for m in metas])
+        svc.set_utilization(self._busy_scores(metas))
+        svc.rebalance()
+
     def register(self, client_id: str, service: str) -> dict:
         redirect = self._redirect(service)
         if redirect is not None:
             return redirect
         with self._lock:
+            # registry read INSIDE the lock: serialized against tick(),
+            # so a stale snapshot can never overwrite a fresher one
+            # (spurious teacher-drop + double version bump otherwise)
+            metas = self.registry.get_service(service)
             svc = self._services.setdefault(service, ServiceBalance(service))
             fresh = svc.add_client(client_id, self._clock())
-            svc.set_servers(self._teacher_list(service))
-            svc.rebalance()
+            self._apply_registry(svc, metas)
             links = svc.get(client_id)
             status = "OK" if fresh else "ALREADY_REGISTER"
             log.info("client %s -> service %s (%s, %d teachers)", client_id,
@@ -124,8 +139,17 @@ class BalanceTable:
 
     # -- tick ---------------------------------------------------------------
 
-    def _teacher_list(self, service: str) -> list[str]:
-        return [m.server for m in self.registry.get_service(service)]
+    @staticmethod
+    def _busy_scores(metas) -> dict[str, float]:
+        """Registrar-published busy fractions (`util` in the info JSON)
+        — the balancer's tie-break (balance.py invariant I6)."""
+        scores = {}
+        for m in metas:
+            try:
+                scores[m.server] = float(json.loads(m.info)["util"])
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+                continue  # no/old-format info: neutral score
+        return scores
 
     def tick(self) -> None:
         """Refresh teacher membership, expire silent clients, rebalance."""
@@ -136,20 +160,23 @@ class BalanceTable:
         with self._lock:
             names = list(self._services)
         for name in names:
-            try:
-                teachers = self._teacher_list(name)
-            except Exception as exc:
-                log.warning("teacher poll for %s failed: %s", name, exc)
-                continue
             with self._lock:
                 svc = self._services.get(name)
                 if svc is None:
                     continue
+                try:
+                    # read inside the lock (as register() does): the
+                    # snapshot installed is never older than one a
+                    # concurrent caller installed before us
+                    metas = self.registry.get_service(name)
+                except Exception as exc:
+                    log.warning("teacher poll for %s failed: %s", name,
+                                exc)
+                    continue
                 dead = svc.expire_clients(self._clock(), self.client_ttl)
                 for cid in dead:
                     log.info("client %s expired from %s", cid, name)
-                svc.set_servers(teachers)
-                svc.rebalance()
+                self._apply_registry(svc, metas)
 
     def stats(self) -> dict:
         with self._lock:

@@ -177,3 +177,57 @@ def test_late_joining_server_loaded_immediately():
     assert loads["t2"] >= 2, f"new teacher idle: {loads}"
     assert max(loads.values()) - min(loads.values()) <= 1
     assert changed, "no client was re-versioned despite moved links"
+
+
+def test_utilization_breaks_ties_toward_idle_teachers():
+    """I6: among equally-loaded candidates the least-busy teacher gets
+    the link, so an under-subscribed service leaves its BUSIEST servers
+    idle (utilization is registrar-published; discovery feeds it in)."""
+    svc = ServiceBalance("s")
+    svc.set_servers(["t0", "t1", "t2", "t3", "t4"])
+    svc.set_utilization({"t0": 0.9, "t1": 0.1, "t2": 0.8, "t3": 0.2,
+                         "t4": 0.3})
+    svc.add_client("c0")
+    svc.add_client("c1")
+    svc.rebalance()
+    check_invariants(svc)
+    used = {s for links in (svc.get("c0"), svc.get("c1"))
+            for s in links.servers}
+    # client_cap = 5//2 = 2 -> 4 links; the idle leftover must be the
+    # busiest teacher
+    assert len(used) == 4 and "t0" not in used, used
+
+
+def test_unknown_utilization_is_neutral_not_idle():
+    """A non-reporting teacher must not beat one honestly reporting a
+    small util (it could be saturated for all we know); it must still
+    beat one reporting heavy load."""
+    svc = ServiceBalance("s")
+    svc.set_servers(["busy", "light", "silent"])
+    svc.set_utilization({"busy": 0.9, "light": 0.1})  # silent: unknown
+    svc.add_client("c0")  # client_cap = 3 -> takes all; order probes...
+    svc.rebalance()
+    # 2 clients, 3 servers: client_cap=1, server_cap=1 -> one idle
+    svc.add_client("c1")
+    svc.rebalance()
+    check_invariants(svc)
+    used = {s for c in ("c0", "c1") for s in svc.get(c).servers}
+    assert used == {"light", "silent"}, used  # busy reporter left out
+
+
+def test_utilization_never_violates_count_invariants():
+    """I6 is a tie-break ONLY: adversarial busy scores cannot skew link
+    counts (I1-I4 keep holding)."""
+    import random as _random
+    rng = _random.Random(7)
+    svc = ServiceBalance("s")
+    servers = [f"t{i}" for i in range(6)]
+    svc.set_servers(servers)
+    for i in range(9):
+        svc.add_client(f"c{i}")
+    for _ in range(30):
+        svc.set_utilization({s: rng.random() for s in servers})
+        svc.rebalance()
+        check_invariants(svc)
+        loads = svc.loads()
+        assert max(loads.values()) - min(loads.values()) <= 1

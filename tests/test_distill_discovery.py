@@ -173,3 +173,45 @@ def test_discovery_replicas_register_in_ring(store, registry):
     with make_server(store) as srv:
         metas = registry.get_service(BALANCE_SERVICE)
         assert [m.server for m in metas] == [srv.endpoint]
+
+
+def test_busy_teachers_deprioritized_via_registrar_info(store, registry):
+    """Registrar-published `util` flows through the discovery tick into
+    the balancer's tie-break (balance.py I6): with more teachers than
+    the clients can use, the busiest teacher stays idle."""
+    import json
+
+    regs = []
+    utils = {"127.0.0.1:9100": 0.95, "127.0.0.1:9101": 0.05,
+             "127.0.0.1:9102": 0.10, "127.0.0.1:9103": 0.15,
+             "127.0.0.1:9104": 0.20}
+    for ep, u in utils.items():
+        regs.append(registry.register(
+            "svc", ep, info=json.dumps({"util": u}), ttl=5.0))
+    with make_server(store) as srv:
+        clients = [DiscoveryClient(srv.endpoint, "svc",
+                                   heartbeat_interval=0.1).start()
+                   for _ in range(2)]
+        try:
+            for c in clients:
+                c.wait_for_servers(timeout=10.0)
+            # The FIRST client is briefly assigned all 5 teachers while
+            # alone (client_cap=5//1); poll to the 2-client steady state
+            # where client_cap = 5//2 = 2 -> 4 links total.
+            deadline = time.time() + 10
+            used = set()
+            while time.time() < deadline:
+                sets = [set(c.get_servers()) for c in clients]
+                used = sets[0] | sets[1]
+                if all(len(s) == 2 for s in sets):
+                    break
+                time.sleep(0.1)
+            assert all(len(set(c.get_servers())) == 2 for c in clients)
+            # the busy teacher is the one left out
+            assert "127.0.0.1:9100" not in used, used
+            assert len(used) == 4
+        finally:
+            for c in clients:
+                c.stop()
+    for r in regs:
+        r.stop()
