@@ -404,21 +404,16 @@ def bench_distill(on_tpu: bool) -> dict:
     def tforward_topk(images):
         val, idx = jax.lax.top_k(
             tforward(images).astype(jnp.float32), serve_topk)
-        # ONE packed (B, 2K) fp32 output = ONE device->host fetch: the
-        # tunnel (and a real PCIe path) pays per-transfer latency, so
-        # two tiny pulls would cost more than one 4 KB one
-        idx_bits = jax.lax.bitcast_convert_type(
-            idx.astype(jnp.int32), jnp.float32)
-        return jnp.concatenate([idx_bits, val], axis=1)
+        return idx.astype(jnp.int32), val.astype(jnp.float16)
 
     def tpredict(feeds):
-        packed = np.asarray(
-            tforward_topk(jnp.asarray(feeds["image"])), np.float32)
-        idx = np.ascontiguousarray(
-            packed[:, :serve_topk]).view(np.int32)
-        val = packed[:, serve_topk:]
-        return {"logits.idx": idx,
-                "logits.val": val.astype(np.float16)}
+        # device arrays returned UNFETCHED (r6): jit dispatch is async,
+        # so the batcher's complete stage pulls these to host while the
+        # chip computes the NEXT coalesced batch — per-transfer latency
+        # now hides under compute instead of needing the r5 packed
+        # single-fetch trick.
+        idx, val = tforward_topk(jnp.asarray(feeds["image"]))
+        return {"logits.idx": idx, "logits.val": val}
 
     compressed_meta = {"logits": {"topk": serve_topk, "classes": classes,
                                   "values": "<f2"}}
@@ -458,10 +453,15 @@ def bench_distill(on_tpu: bool) -> dict:
     loader = DataLoader(source, batch_size)
 
     wire_keys = ("image", "logits.idx", "logits.val")
+    # r6 overlap knobs: requests kept in flight per teacher connection
+    # (hides the serving round trip under student compute) and the
+    # host->device double-buffer depth for the next distill batch
+    pipe_depth = 8 if on_tpu else 4
 
     def student_run(predict_fn, state):
         """The full student pipeline against `predict_fn` as the
         teacher; returns (img/s, batcher stats)."""
+        from edl_tpu.data.pipeline import prefetch_to_device
         server = TeacherServer(predict_fn, max_batch=4 * teacher_bs,
                                buckets=(teacher_bs, 2 * teacher_bs,
                                         4 * teacher_bs),
@@ -480,27 +480,27 @@ def bench_distill(on_tpu: bool) -> dict:
                                     teachers=[endpoint],
                                     teacher_batch_size=teacher_bs,
                                     rpc_timeout=120.0,
+                                    pipeline_depth=pipe_depth,
                                     compress_topk=serve_topk,
                                     sparse_predicts=True)
             it = dreader()
+            wire_only = ({k: np.ascontiguousarray(v)
+                          for k, v in b.items() if k in wire_keys}
+                         for b in it)
+            # double-buffered device_put: batch i+1 transfers while the
+            # student trains on batch i
+            staged = prefetch_to_device(wire_only, sharding, size=2)
             for _ in range(2):
-                batch = next(it)
-                placed = {k: jax.device_put(np.ascontiguousarray(v),
-                                            sharding)
-                          for k, v in batch.items() if k in wire_keys}
-                state, metrics = step(state, placed)
+                state, metrics = step(state, next(staged))
             _sync(metrics["loss"])
 
             t0 = time.perf_counter()
             for _ in range(steps):
-                batch = next(it)
-                placed = {k: jax.device_put(np.ascontiguousarray(v),
-                                            sharding)
-                          for k, v in batch.items() if k in wire_keys}
-                state, metrics = step(state, placed)
+                state, metrics = step(state, next(staged))
             _sync(metrics["loss"])
             dt = time.perf_counter() - t0
             stats = server.batcher.stats()
+            staged.close()
             it.close()
             dreader.close()
         finally:
@@ -543,13 +543,15 @@ def bench_distill(on_tpu: bool) -> dict:
 
     from edl_tpu.distill.teacher_server import TeacherClient
 
+    from collections import deque
+
     server = TeacherServer(tpredict, max_batch=4 * teacher_bs,
                            buckets=(teacher_bs, 2 * teacher_bs,
                                     4 * teacher_bs),
                            compressed_meta=compressed_meta).start()
     try:
         endpoint = f"127.0.0.1:{server.port}"
-        n_clients, reqs_per_client = 4, max(2, steps)
+        n_clients, reqs_per_client = 4, max(4, 2 * steps)
         img = np.zeros((teacher_bs, hw, hw, 3), np.uint8)
         # warm the serving path end-to-end before timing
         c0 = TeacherClient(endpoint, timeout=120.0, expand=False)
@@ -558,12 +560,20 @@ def bench_distill(on_tpu: bool) -> dict:
         served, client_errs = [], []
 
         def client():
+            # r6: pipelined — keep pipe_depth requests in flight per
+            # connection so the wire decode/encode, coalesce, chip
+            # compute, and host fetch stages all stay busy at once
             try:
-                c = TeacherClient(endpoint, timeout=120.0, expand=False)
+                c = TeacherClient(endpoint, timeout=120.0, expand=False,
+                                  max_inflight=pipe_depth)
                 n = 0
+                handles = deque()
                 for _ in range(reqs_per_client):
-                    out = c.predict({"image": img})
-                    n += len(out["logits.idx"])
+                    if len(handles) >= pipe_depth:
+                        n += len(handles.popleft().result()["logits.idx"])
+                    handles.append(c.predict_async({"image": img}))
+                while handles:
+                    n += len(handles.popleft().result()["logits.idx"])
                 c.close()
                 served.append(n)
             except Exception as exc:  # noqa: BLE001 — re-raised below
@@ -583,6 +593,7 @@ def bench_distill(on_tpu: bool) -> dict:
                 f"teacher bench client failure ({len(served)}/"
                 f"{n_clients} finished): {client_errs[:1]}")
         teacher_imgs_per_sec = sum(served) / tdt
+        serving_stats = server.batcher.stats()
     finally:
         server.stop()
 
@@ -594,6 +605,16 @@ def bench_distill(on_tpu: bool) -> dict:
             "teacher_chip_imgs_per_sec": round(teacher_chip, 1),
             "coalesce_batch_rows_mean": bstats.get("batch_rows_mean", 0.0),
             "coalesce_batch_rows_hist": bstats.get("batch_rows_hist", {}),
+            # r6 overlap observability: reader in-flight depth per
+            # connection, the server's adaptive coalescing window and
+            # intake high-water mark — both for the e2e run and the
+            # teacher-only serving run
+            "pipeline_depth": pipe_depth,
+            "coalesce_window_ms": bstats.get("coalesce_window_ms", 0.0),
+            "pending_hwm": bstats.get("pending_hwm", 0),
+            "serving_batch_rows_mean":
+                serving_stats.get("batch_rows_mean", 0.0),
+            "serving_pending_hwm": serving_stats.get("pending_hwm", 0),
             # response-direction bytes per image: dense fp32 classes vs
             # the served top-k (int32 idx + fp16 val)
             "wire_logits_bytes_dense": classes * 4,
@@ -671,6 +692,16 @@ def main() -> None:
                 distill["teacher_chip_imgs_per_sec"],
             "teacher_coalesce_batch_rows_mean":
                 distill["coalesce_batch_rows_mean"],
+            # r6: the overlapped serving path — reader requests in
+            # flight per teacher connection, server adaptive-coalesce
+            # window + intake depth (e2e and teacher-only runs)
+            "distill_pipeline_depth": distill["pipeline_depth"],
+            "teacher_coalesce_window_ms": distill["coalesce_window_ms"],
+            "teacher_pending_hwm": distill["pending_hwm"],
+            "teacher_serving_batch_rows_mean":
+                distill["serving_batch_rows_mean"],
+            "teacher_serving_pending_hwm":
+                distill["serving_pending_hwm"],
             # r5: served top-k wire — bytes/img in the response
             # direction, dense fp32 vs compressed (idx+fp16 val)
             "distill_wire_logits_bytes_dense":

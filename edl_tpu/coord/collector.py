@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import queue
 import sys
 import threading
 import time
@@ -58,8 +59,15 @@ class UtilizationPublisher:
 
     Callable with the TrainLoop hook signature, so wiring it is:
     ``TrainLoop(..., hooks=[UtilizationPublisher(store, job, pod)])``.
-    A store hiccup never touches training: publishing is best-effort
-    with a cooldown after failures.
+
+    The hook itself never touches the store: ``__call__`` builds the
+    document and drops it into a ONE-SLOT latest-wins mailbox; a
+    background thread owns the lease and the store writes. A hung or
+    slow store therefore can't stall a train step for its timeout —
+    the worst case before r6, where every log-point put rode the
+    training thread for up to the store's ~10 s timeout. Publishing
+    stays best-effort with a cooldown after failures; ``flush()``
+    waits for the mailbox to drain (tests, orderly shutdown).
     """
 
     def __init__(self, store: Store, job_id: str, pod_id: str, *,
@@ -82,6 +90,11 @@ class UtilizationPublisher:
         self._last_t = time.monotonic()
         self._cooldown_until = 0.0
         self._owns_store = False  # from_env's connection: close on stop
+        # latest-wins mailbox + lazily-started publisher thread
+        self._mailbox: "queue.Queue[dict]" = queue.Queue(maxsize=1)
+        self._pending = 0                # snapshots enqueued, unpublished
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
 
     @classmethod
     def from_env(cls) -> "UtilizationPublisher | None":
@@ -123,9 +136,11 @@ class UtilizationPublisher:
 
     def __call__(self, loop, epoch: int, step: int,
                  metrics: dict | None = None) -> None:
+        """Training-thread side: bookkeeping + mailbox drop only — no
+        store I/O ever happens here."""
         now = time.monotonic()
         with self._lock:
-            if now < self._cooldown_until \
+            if self._stop.is_set() \
                     or now - self._last_pub < self.min_interval:
                 return
             samples = int(getattr(loop.status, "samples_seen", 0)) \
@@ -141,22 +156,70 @@ class UtilizationPublisher:
                    "samples_seen": samples,
                    "examples_per_sec": round(max(rate, 0.0), 2),
                    "ts": time.time()}
-            try:
-                self.store.put(util_key(self.job_id, self.pod_id),
-                               json.dumps(doc, sort_keys=True),
-                               lease=self._ensure_lease())
-            except Exception as exc:  # noqa: BLE001 — best-effort: a
-                # publishing failure of ANY kind must never kill training
-                log.warning("utilization publish failed (%s); pausing "
-                            "30s", exc)
-                self._cooldown_until = now + 30.0
-                self._lease = None
-                return
             self._last_pub = now
             self._last_samples = samples
             self._last_t = now
+            # latest-wins: a stalled publisher drops the OLD snapshot
+            while True:
+                try:
+                    self._mailbox.put_nowait(doc)
+                    self._pending += 1
+                    break
+                except queue.Full:
+                    try:
+                        self._mailbox.get_nowait()
+                        self._pending -= 1
+                    except queue.Empty:
+                        pass
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._publish_loop, daemon=True,
+                    name="util-publisher")
+                self._thread.start()
+
+    def _publish_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                doc = self._mailbox.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._publish(doc)
+            finally:
+                with self._lock:
+                    self._pending -= 1
+
+    def _publish(self, doc: dict) -> None:
+        now = time.monotonic()
+        if now < self._cooldown_until:
+            return
+        try:
+            self.store.put(util_key(self.job_id, self.pod_id),
+                           json.dumps(doc, sort_keys=True),
+                           lease=self._ensure_lease())
+        except Exception as exc:  # noqa: BLE001 — best-effort: a
+            # publishing failure of ANY kind must never kill training
+            log.warning("utilization publish failed (%s); pausing 30s", exc)
+            self._cooldown_until = now + 30.0
+            self._lease = None
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Wait for every enqueued snapshot to be published (or dropped
+        by the cooldown); True when the mailbox drained in time."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._pending <= 0:
+                    return True
+            time.sleep(0.01)
+        return False
 
     def stop(self) -> None:
+        self.flush(timeout=2.0)   # best-effort final snapshot
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
         with self._lock:
             if self._keeper is not None:
                 self._keeper.stop(revoke=True)

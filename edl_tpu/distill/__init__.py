@@ -25,7 +25,6 @@ keeps targets sparse on device).
 
 from edl_tpu.distill.balance import ServiceBalance
 from edl_tpu.distill.reader import DistillReader, EdlDistillError
-from edl_tpu.distill.sharded_teacher import sharded_predict_fn
 from edl_tpu.distill.teacher_server import (TeacherClient, TeacherServer,
                                             compress_outputs,
                                             expand_outputs)
@@ -33,3 +32,17 @@ from edl_tpu.distill.teacher_server import (TeacherClient, TeacherServer,
 __all__ = ["ServiceBalance", "DistillReader", "EdlDistillError",
            "TeacherClient", "TeacherServer", "compress_outputs",
            "expand_outputs", "sharded_predict_fn"]
+
+
+def __getattr__(name: str):
+    # sharded_teacher pulls in jax + the mesh machinery at import time;
+    # loading it lazily keeps `import edl_tpu.distill` working for
+    # wire-only/CPU consumers (a student host that only needs
+    # TeacherClient + numpy, a registrar sidecar, ...).
+    if name in ("sharded_predict_fn", "sharded_teacher"):
+        import importlib
+        # NOT `from edl_tpu.distill import ...` — the fromlist machinery
+        # re-enters this __getattr__ and recurses
+        mod = importlib.import_module("edl_tpu.distill.sharded_teacher")
+        return mod if name == "sharded_teacher" else mod.sharded_predict_fn
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

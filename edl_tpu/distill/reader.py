@@ -17,11 +17,15 @@ tests/test_distill_reader.py under teacher kill/join):
   D1. every yielded batch carries predictions for exactly its own rows, in
       row order (out-of-order teacher replies are re-assembled by task id);
   D2. batches are yielded in reader order;
-  D3. a teacher failure re-queues its in-flight task (bounded retries);
-      nothing is lost or duplicated across teacher churn;
+  D3. a teacher failure re-queues its in-flight tasks (bounded retries);
+      nothing is lost or duplicated across teacher churn — with request
+      pipelining (r6) a worker may own up to ``pipeline_depth`` tasks on
+      one connection, and a mid-flight death re-queues every one of them
+      exactly once;
   D4. the epoch terminates exactly when every sliced task has been served
       (feed-count == serve-count accounting, the poison-pill role);
-  D5. backpressure: at most ``2*teachers + 2`` tasks in flight;
+  D5. backpressure: at most ``(pipeline_depth+1)*teachers + 2`` tasks in
+      flight;
   D6. liveness: if NO connected teacher serves a task for
       ``deadman_timeout`` seconds while work is outstanding AND some
       teacher is known-dead, the epoch raises EdlDistillError naming the
@@ -37,6 +41,7 @@ import os
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Iterator
 
@@ -99,8 +104,13 @@ class _NopTeacherClient:
 class _PredictWorker(threading.Thread):
     """Owns one teacher connection; serves tasks from the shared queue.
 
-    A task is owned from get() until either a successful out_queue.put or a
-    re-queue — exactly-once across worker death (invariant D3)."""
+    A task is owned from get() until either a successful out_queue.put or
+    a re-queue — exactly-once across worker death (invariant D3). With a
+    pipelining-capable client (``predict_async``) the worker keeps up to
+    ``pipeline_depth`` requests in flight on its one connection, so
+    teacher round-trip latency hides under the teacher's own compute and
+    the student's train step; responses resolve FIFO and stay
+    sequence-checked inside the client."""
 
     def __init__(self, pipeline: "_EpochPipeline", endpoint: str):
         super().__init__(daemon=True, name=f"distill-predict-{endpoint}")
@@ -109,6 +119,27 @@ class _PredictWorker(threading.Thread):
         self.stop_event = threading.Event()
         self.broken = threading.Event()
         self.connected = threading.Event()  # client_factory succeeded
+
+    def _check_outs(self, outs: dict) -> str | None:
+        """Response contract checks; returns the failure reason."""
+        p = self.pipeline
+        missing = [k for k in p.predicts if k not in outs]
+        if missing:
+            return f"missing predicts {missing}"
+        if p.sparse_predicts and p.compress_topk:
+            # per-part top-k consistency: a teacher serving a different K
+            # than negotiated would otherwise surface batches later as an
+            # opaque np.concatenate shape error with no endpoint
+            for name in outs:
+                if not name.endswith((".idx", ".val")):
+                    continue
+                arr = outs[name]
+                if arr.ndim >= 1 and arr.shape[-1] != p.compress_topk:
+                    return (f"served top-{arr.shape[-1]} for {name!r} but "
+                            f"the negotiated compress_topk is "
+                            f"{p.compress_topk} (shape {arr.shape}); "
+                            f"mixed --serve-topk across the pool?")
+        return None
 
     def run(self) -> None:
         p = self.pipeline
@@ -122,35 +153,71 @@ class _PredictWorker(threading.Thread):
             return
         self.connected.set()
         p.dead_teachers.pop(self.endpoint, None)
+        depth = (p.pipeline_depth
+                 if hasattr(client, "predict_async") else 1)
+        inflight: deque = deque()   # [(task, handle-or-None)] send order
+
+        def die(exc: Exception, task: Task) -> None:
+            """Connection-level failure: every in-flight task on this
+            connection is lost; re-queue each exactly once (D3)."""
+            task.retries += 1
+            log.warning("teacher %s failed task %d (try %d): %s",
+                        self.endpoint, task.task_id, task.retries, exc)
+            p.dead_teachers[self.endpoint] = f"predict: {exc}"
+            for t, _ in inflight:
+                if t is not task:
+                    t.retries += 1
+            too_many = [t for t, _ in inflight
+                        if t.retries > p.max_retries]
+            if too_many:
+                p.fail(f"task {too_many[0].task_id} failed "
+                       f"{too_many[0].retries} times: {exc}")
+            else:
+                for t, _ in inflight:
+                    p.in_queue.put(t)   # another worker re-serves them
+            inflight.clear()
+            self.broken.set()
+
         try:
             while not self.stop_event.is_set():
-                try:
-                    task: Task = p.in_queue.get(timeout=0.2)
-                except queue.Empty:
+                # fill the window; block on intake only when idle
+                while len(inflight) < depth:
+                    try:
+                        task = (p.in_queue.get(timeout=0.2) if not inflight
+                                else p.in_queue.get_nowait())
+                    except queue.Empty:
+                        break
+                    inflight.append((task, None))
+                    if depth > 1:
+                        try:
+                            with tl.span("send"):
+                                handle = client.predict_async(task.feeds)
+                        except Exception as exc:
+                            die(exc, task)
+                            return
+                        inflight[-1] = (task, handle)
+                if not inflight:
                     continue
+                task, handle = inflight[0]
                 try:
                     with tl.span("predict"):
-                        outs = client.predict(task.feeds)
+                        outs = (handle.result() if handle is not None
+                                else client.predict(task.feeds))
                 except Exception as exc:
-                    task.retries += 1
-                    log.warning("teacher %s failed task %d (try %d): %s",
-                                self.endpoint, task.task_id, task.retries,
-                                exc)
-                    p.dead_teachers[self.endpoint] = f"predict: {exc}"
-                    if task.retries > p.max_retries:
-                        p.fail(f"task {task.task_id} failed "
-                               f"{task.retries} times: {exc}")
-                    else:
-                        p.in_queue.put(task)   # another worker re-serves it
-                    self.broken.set()
+                    die(exc, task)
                     return
-                missing = [k for k in p.predicts if k not in outs]
-                if missing:
-                    p.fail(f"teacher {self.endpoint} missing predicts "
-                           f"{missing}")
+                inflight.popleft()
+                reason = self._check_outs(outs)
+                if reason is not None:
+                    p.fail(f"teacher {self.endpoint} {reason}")
                     return
                 p.out_queue.put((task, outs))
         finally:
+            # stopped mid-flight (teacher departed the desired set, epoch
+            # teardown): hand unserved tasks back — they did not fail, so
+            # no retry is charged
+            for t, _ in inflight:
+                p.in_queue.put(t)
             client.close()
 
 
@@ -161,13 +228,17 @@ class _EpochPipeline:
         self.predicts = reader._wire_predicts
         self.max_retries = reader.max_retries
         self.client_factory = reader._client_factory
+        self.pipeline_depth = reader.pipeline_depth
+        self.compress_topk = reader.compress_topk
+        self.sparse_predicts = reader.sparse_predicts
         self.in_queue: queue.Queue = queue.Queue()
         self.out_queue: queue.Queue = queue.Queue()
         self.stop = threading.Event()
         self.error: list[str] = []
         n0 = max(1, len(reader._get_servers()))
-        self.sem = threading.Semaphore(2 * n0 + 2)
-        self._sem_slots = 2 * n0 + 2   # manage-thread-owned bookkeeping
+        slots = self._window(n0)
+        self.sem = threading.Semaphore(slots)
+        self._sem_slots = slots   # manage-thread-owned bookkeeping
         self.reader_done = threading.Event()
         self.total_tasks = 0        # valid once reader_done is set
         self.total_batches = 0
@@ -190,12 +261,17 @@ class _EpochPipeline:
                 return True
         return False
 
+    def _window(self, n_teachers: int) -> int:
+        """In-flight task window: per-connection pipelining depth + one
+        task resolving at the head, per teacher, + slack (reduces to the
+        reference's 2*teachers+2 at depth 1, distill_reader.py:215)."""
+        return (self.pipeline_depth + 1) * max(1, n_teachers) + 2
+
     def resize_window(self, n_teachers: int) -> None:
-        """Track the live teacher count: in-flight window = 2*teachers+2
-        (the reference sizes it live, distill_reader.py:215), so a teacher
-        joining mid-epoch actually widens throughput. Called only from the
-        manage thread; shrink is best-effort (never blocks the pipeline)."""
-        target = 2 * max(1, n_teachers) + 2
+        """Track the live teacher count so a teacher joining mid-epoch
+        actually widens throughput. Called only from the manage thread;
+        shrink is best-effort (never blocks the pipeline)."""
+        target = self._window(n_teachers)
         while self._sem_slots < target:
             self.sem.release()
             self._sem_slots += 1
@@ -239,6 +315,11 @@ class DistillReader:
       deadman_timeout: seconds without any connected teacher serving a
         task (while work is outstanding) before the epoch raises
         EdlDistillError instead of waiting forever (invariant D6).
+      pipeline_depth: in-flight requests kept per teacher connection
+        (request pipelining; the client sequence-tags them and the server
+        answers FIFO). Depth 1 restores strict request/response lockstep;
+        clients without ``predict_async`` (test fakes) always run at
+        depth 1. The reader window scales with it (invariant D5).
       compress_topk: negotiate top-k+fp16 logit compression with the
         teacher (~125x smaller response wire at 1000 classes, K=8; see
         teacher_server.compress_outputs). Default: transparently
@@ -262,6 +343,7 @@ class DistillReader:
                  client_factory: Callable | None = None,
                  rpc_timeout: float = 30.0,
                  deadman_timeout: float = 60.0,
+                 pipeline_depth: int = 4,
                  compress_topk: int = 0,
                  compress_values: str = "float16",
                  sparse_predicts: bool = False):
@@ -286,6 +368,8 @@ class DistillReader:
         self.max_retries = max_retries
         self.manage_interval = manage_interval
         self.deadman_timeout = deadman_timeout
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self.compress_topk = int(compress_topk)
         self._fixed_teachers = list(teachers) if teachers else None
         self._discovery_endpoints = discovery
         self._service = service
@@ -300,7 +384,8 @@ class DistillReader:
                 client_factory = lambda ep: TeacherClient(  # noqa: E731
                     ep, timeout=rpc_timeout, compress_topk=compress_topk,
                     compress_values=compress_values,
-                    expand=not sparse_predicts)
+                    expand=not sparse_predicts,
+                    max_inflight=self.pipeline_depth)
         self._client_factory = client_factory
 
     # -- teacher set --------------------------------------------------------
@@ -484,6 +569,16 @@ class DistillReader:
             except Exception as exc:
                 log.warning("teacher discovery failed: %s", exc)
                 desired = set(workers)
+            else:
+                # Prune dead-teacher records for endpoints no longer in
+                # the discovered set: a teacher that departed AND was
+                # removed from assignment must not permanently trip the
+                # scale-to-zero deadman below (the D6 docstring's
+                # empty-pool promise). Fixed teachers stay in `desired`,
+                # so their records — and the fail-fast — survive.
+                for ep in list(p.dead_teachers):
+                    if ep not in desired:
+                        p.dead_teachers.pop(ep, None)
             for ep in list(workers):
                 w = workers[ep]
                 if ep not in desired or w.broken.is_set() \

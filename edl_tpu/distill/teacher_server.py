@@ -12,6 +12,10 @@ Protocol (tensor_wire frames):
     request  meta {"op": "predict"}          tensors {feed_name: array}
     response meta {"ok": true}               tensors {fetch_name: array}
     request  meta {"op": "ping"}             -> {"ok": true}, no tensors
+Requests may carry {"seq": n}; the response echoes it. Responses on one
+connection come back strictly in request order, and the server does NOT
+wait for a predict to finish before reading the next request — clients
+may pipeline many requests per connection (TeacherClient.predict_async).
 
 Wire compression (two independent levers; see `compress_outputs`):
   - client-negotiated: request meta carries {"compress": {"topk": K,
@@ -38,6 +42,7 @@ import socket
 import socketserver
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -68,19 +73,65 @@ class _Request:
 
 
 class Batcher:
-    """Coalesce concurrent predict requests into padded device batches."""
+    """Coalesce concurrent predict requests into padded device batches.
+
+    Staged pipeline (r6): three threads connected by bounded queues so
+    the chip never waits on host work —
+
+        coalesce  — collect + concatenate + pad the next group while the
+                    chip computes the current one (adaptive window below);
+        compute   — calls predict_fn; with an async-dispatch backend
+                    (jitted JAX) the call returns device arrays without
+                    blocking, so the thread immediately feeds the chip
+                    the NEXT coalesced batch;
+        complete  — fetches outputs to host (np.asarray = the device->host
+                    sync), slices per request, sets done. Overlaps the
+                    transfer of batch N with the compute of batch N+1.
+
+    (De)serialization and `compress_outputs` run on the per-connection
+    handler/writer threads (see `_Handler`), never here.
+
+    Adaptive coalescing window: a group closes after ``max_wait`` ONLY
+    when the device pipeline is idle (dispatching early actually starts
+    work). While a previous group is still in flight the window extends
+    up to ``max_wait_cap`` — waiting costs nothing then, the chip could
+    not take the group anyway — so pipelined clients coalesce toward
+    ``max_batch`` rows under steady load without ever inserting an idle
+    bubble under light load.
+    """
 
     def __init__(self, predict_fn, *, max_batch: int = 64,
                  max_wait: float = 0.002,
-                 buckets: tuple[int, ...] = DEFAULT_BUCKETS):
+                 buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+                 max_wait_cap: float | None = None,
+                 stage_depth: int = 2):
         self.predict_fn = predict_fn
         self.max_batch = max_batch
         self.max_wait = max_wait
+        self.max_wait_cap = (max_wait_cap if max_wait_cap is not None
+                             else max(8 * max_wait, 0.016))
         self.buckets = tuple(sorted(buckets))
         self._q: queue.Queue[_Request | None] = queue.Queue()
+        # bounded stage queues: coalesce may run at most `stage_depth`
+        # groups ahead of the chip, the chip at most `stage_depth` ahead
+        # of the host fetch
+        self._compute_q: queue.Queue = queue.Queue(maxsize=stage_depth)
+        self._post_q: queue.Queue = queue.Queue(maxsize=stage_depth)
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="teacher-batcher")
+        self._threads = [
+            threading.Thread(target=self._run_coalesce, daemon=True,
+                             name="teacher-coalesce"),
+            threading.Thread(target=self._run_compute, daemon=True,
+                             name="teacher-compute"),
+            threading.Thread(target=self._run_complete, daemon=True,
+                             name="teacher-complete"),
+        ]
+        # adaptive-window state: groups currently past coalesce (queued,
+        # computing, or fetching) — the "device busy" signal; plus an EMA
+        # of realized window lengths for observability
+        self._groups_inflight = 0
+        self._window_ema_s = max_wait
+        self._carry: _Request | None = None
         # Cumulative utilization counters (the registry `info` data source:
         # reference discovery/register.py:36-40 reserves the field for
         # "report job performance to the scheduler").
@@ -88,7 +139,9 @@ class Batcher:
         self._served_rows = 0
         self._served_requests = 0
         self._busy_s = 0.0
+        self._busy_until = 0.0   # interval-union accounting across stages
         self._started_at = time.monotonic()
+        self._pending_hwm = 0    # intake high-water mark: observed demand
         # Coalescing histogram: device-batch ROW count (pre-padding) ->
         # number of served groups. Whether concurrent client requests
         # actually merge (vs degenerate 1-request batches) is THE
@@ -97,91 +150,149 @@ class Batcher:
         self._batch_hist: dict[int, int] = {}
 
     def start(self) -> "Batcher":
-        self._thread.start()
+        for t in self._threads:
+            t.start()
         return self
 
     def submit(self, tensors: dict[str, np.ndarray]) -> _Request:
         rows = next(iter(tensors.values())).shape[0] if tensors else 0
         req = _Request(tensors=tensors, rows=rows)
+        depth = self._q.qsize() + 1
+        if depth > self._pending_hwm:
+            with self._stats_lock:
+                self._pending_hwm = max(self._pending_hwm, depth)
         self._q.put(req)
         return req
 
     def _collect(self) -> list[_Request]:
-        """One blocking pop, then drain whatever arrives within max_wait
-        (bounded by max_batch rows)."""
-        try:
-            first = self._q.get(timeout=0.2)
-        except queue.Empty:
-            return []
+        """One blocking pop, then drain whatever arrives within the
+        adaptive window (bounded by max_batch rows)."""
+        first = self._carry
+        self._carry = None
         if first is None:
-            return []
-        group, rows = [first], first.rows
-        deadline = time.monotonic() + self.max_wait
-        while rows < self.max_batch:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                break
             try:
-                req = self._q.get(timeout=remaining)
+                first = self._q.get(timeout=0.2)
             except queue.Empty:
+                return []
+            if first is None:
+                return []
+        t_first = time.monotonic()
+        soft = t_first + self.max_wait
+        hard = t_first + self.max_wait_cap
+        names = list(first.tensors)
+        group, rows = [first], first.rows
+        while rows < self.max_batch:
+            now = time.monotonic()
+            if now >= hard:
                 break
+            with self._stats_lock:
+                busy = self._groups_inflight > 0
+            if now >= soft and not busy:
+                break   # device idle: dispatching NOW starts work
+            # device busy: the chip can't take this group yet, so keep
+            # coalescing (1 ms polls re-check the busy signal)
+            timeout = min((hard if busy else soft) - now, 0.001)
+            try:
+                req = self._q.get(timeout=max(timeout, 0.0))
+            except queue.Empty:
+                continue
             if req is None:
                 break
-            if rows + req.rows > self.max_batch:
-                # Doesn't fit this round: run it in the next group.
-                self._q.put(req)
+            if list(req.tensors) != names \
+                    or rows + req.rows > self.max_batch:
+                # Heterogeneous feeds can't coalesce / doesn't fit this
+                # round: it OPENS the next group (order preserved).
+                self._carry = req
                 break
             group.append(req)
             rows += req.rows
+        window = time.monotonic() - t_first
+        self._window_ema_s += 0.2 * (window - self._window_ema_s)
         return group
 
-    def _run(self) -> None:
+    def _fail_group(self, group: list[_Request], exc: Exception) -> None:
+        log.exception("batch predict failed")
+        for req in group:
+            req.error = f"{type(exc).__name__}: {exc}"
+            req.done.set()
+
+    def _run_coalesce(self) -> None:
         while not self._stop.is_set():
             group = self._collect()
             if not group:
                 continue
+            names = list(group[0].tensors)
+            rows = sum(g.rows for g in group)
+            bucket = pad_to_bucket(rows, self.buckets)
             try:
-                self._serve_group(group)
-            except Exception as exc:
-                log.exception("batch predict failed")
-                for req in group:
-                    if req.done.is_set():
-                        # Heterogeneous requests already served (recursively)
-                        # by _serve_group must not be retroactively failed.
-                        continue
-                    req.error = f"{type(exc).__name__}: {exc}"
-                    req.done.set()
+                feeds = {}
+                for name in names:
+                    cat = np.concatenate([g.tensors[name] for g in group],
+                                         axis=0)
+                    if bucket > rows:
+                        pad = np.zeros((bucket - rows,) + cat.shape[1:],
+                                       cat.dtype)
+                        cat = np.concatenate([cat, pad], axis=0)
+                    feeds[name] = cat
+            except Exception as exc:  # ragged feeds etc.
+                self._fail_group(group, exc)
+                continue
+            with self._stats_lock:
+                self._groups_inflight += 1
+            self._compute_q.put((group, feeds, rows))
+        self._compute_q.put(None)
 
-    def _serve_group(self, group: list[_Request]) -> None:
-        names = list(group[0].tensors)
-        for req in group[1:]:
-            if list(req.tensors) != names:
-                # Heterogeneous feeds can't coalesce; serve separately.
-                self._serve_group([req])
-        group = [g for g in group if list(g.tensors) == names]
-        rows = sum(g.rows for g in group)
-        bucket = pad_to_bucket(rows, self.buckets)
-        feeds = {}
-        for name in names:
-            cat = np.concatenate([g.tensors[name] for g in group], axis=0)
-            if bucket > rows:
-                pad = np.zeros((bucket - rows,) + cat.shape[1:], cat.dtype)
-                cat = np.concatenate([cat, pad], axis=0)
-            feeds[name] = cat
-        t0 = time.monotonic()
-        outs = self.predict_fn(feeds)
-        outs = {k: np.asarray(v) for k, v in outs.items()}
+    def _group_left(self) -> None:
         with self._stats_lock:
-            self._busy_s += time.monotonic() - t0
-            self._served_rows += rows
-            self._served_requests += len(group)
-            self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
-        offset = 0
-        for req in group:
-            req.result = {k: v[offset:offset + req.rows]
-                          for k, v in outs.items()}
-            offset += req.rows
-            req.done.set()
+            self._groups_inflight -= 1
+
+    def _run_compute(self) -> None:
+        while True:
+            item = self._compute_q.get()
+            if item is None:
+                break
+            group, feeds, rows = item
+            t0 = time.monotonic()
+            try:
+                outs = self.predict_fn(feeds)
+            except Exception as exc:
+                self._fail_group(group, exc)
+                self._group_left()
+                continue
+            self._post_q.put((group, outs, rows, t0))
+        self._post_q.put(None)
+
+    def _run_complete(self) -> None:
+        while True:
+            item = self._post_q.get()
+            if item is None:
+                break
+            group, outs, rows, t0 = item
+            try:
+                # the device->host fetch; predict_fn may return device
+                # arrays (async dispatch) so the chip is already on the
+                # next batch while this blocks
+                outs = {k: np.asarray(v) for k, v in outs.items()}
+            except Exception as exc:
+                self._fail_group(group, exc)
+                self._group_left()
+                continue
+            now = time.monotonic()
+            with self._stats_lock:
+                # union of [t0, now] intervals: overlapped stages must not
+                # double-count device busy time
+                self._busy_s += max(0.0, now - max(t0, self._busy_until))
+                self._busy_until = now
+                self._served_rows += rows
+                self._served_requests += len(group)
+                self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
+                self._groups_inflight -= 1
+            offset = 0
+            for req in group:
+                req.result = {k: v[offset:offset + req.rows]
+                              for k, v in outs.items()}
+                offset += req.rows
+                req.done.set()
 
     def stats(self) -> dict:
         """Cumulative serving counters (consumed by TeacherRegistrar)."""
@@ -195,6 +306,9 @@ class Batcher:
                     "busy_s": round(self._busy_s, 4),
                     "uptime_s": round(time.monotonic() - self._started_at, 4),
                     "queue_depth": self._q.qsize(),
+                    "pending_hwm": self._pending_hwm,
+                    "coalesce_window_ms": round(self._window_ema_s * 1e3,
+                                                3),
                     # JSON object keys are strings on the wire
                     "batch_rows_hist": {str(r): c for r, c in hist.items()},
                     "batch_rows_mean": round(rows_mean, 2)}
@@ -202,7 +316,8 @@ class Batcher:
     def stop(self) -> None:
         self._stop.set()
         self._q.put(None)
-        self._thread.join(timeout=5.0)
+        for t in self._threads:
+            t.join(timeout=5.0)
 
 
 def compress_outputs(outs: dict[str, np.ndarray], spec: dict
@@ -264,66 +379,125 @@ def expand_outputs(meta: dict, tensors: dict[str, np.ndarray]
     return tensors
 
 
+def _predict_response(out: dict[str, np.ndarray], comp: dict | None,
+                      server_meta: dict | None):
+    """Build a predict response: client-negotiated compression + the
+    server-side sparse announcements. Runs on the per-connection WRITER
+    thread, overlapped with the batcher's device stages."""
+    compressed = {}
+    if comp:  # client-negotiated host-side top-k of dense outs
+        # never re-compress outputs the predict_fn already emits
+        # sparse (name.idx/name.val) — a smaller client K would
+        # otherwise shred name.val into name.val.idx/...
+        sparse = {k: v for k, v in out.items()
+                  if k.endswith((".idx", ".val"))}
+        frag, out = compress_outputs(
+            {k: v for k, v in out.items() if k not in sparse}, comp)
+        out.update(sparse)
+        compressed.update(frag.get("compressed", {}))
+    if server_meta:  # predict_fn emitted device-side sparse outs
+        compressed.update(
+            {name: info for name, info in server_meta.items()
+             if name + ".idx" in out})
+    if compressed:
+        return {"ok": True, "compressed": compressed}, out
+    return {"ok": True}, out
+
+
 class _Handler(socketserver.BaseRequestHandler):
+    """Pipelined connection handler: the recv loop submits predict
+    requests to the batcher WITHOUT waiting for results; a per-connection
+    writer thread completes them strictly in request order (encode +
+    compress off the recv path). A client may therefore keep many
+    requests in flight on one connection — responses come back FIFO,
+    tagged with the request's ``seq`` when it carried one.
+
+    Backpressure: at most MAX_INFLIGHT responses are queued per
+    connection; past that the recv loop blocks, which stops reading the
+    socket and lets TCP flow control push back on the client.
+    """
+
+    MAX_INFLIGHT = 128
+
     def handle(self) -> None:
         batcher: Batcher = self.server.batcher  # type: ignore[attr-defined]
         server_meta: dict = getattr(self.server, "compressed_meta", {})
         sock: socket.socket = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        while True:
-            try:
-                meta, tensors = tensor_wire.recv_tensors(sock)
-            except (tensor_wire.TensorWireError, OSError):
-                return
-            try:
-                resp_meta, resp_tensors = self._dispatch(
-                    batcher, meta, tensors, server_meta)
-            except Exception as exc:
-                resp_meta = {"ok": False,
-                             "error": f"{type(exc).__name__}: {exc}"}
-                resp_tensors = {}
-            try:
-                tensor_wire.send_tensors(sock, resp_meta, resp_tensors)
-            except OSError:
-                return
+        resp_q: queue.Queue = queue.Queue(maxsize=self.MAX_INFLIGHT)
+        writer = threading.Thread(
+            target=self._write_loop, args=(sock, resp_q, server_meta),
+            daemon=True, name="teacher-conn-send")
+        writer.start()
+        try:
+            while True:
+                try:
+                    meta, tensors = tensor_wire.recv_tensors(sock)
+                except (tensor_wire.TensorWireError, OSError):
+                    return
+                seq = meta.get("seq")
+                if meta.get("op") == "predict":
+                    if not tensors:
+                        resp_q.put(("done", seq,
+                                    {"ok": False,
+                                     "error": "no feed tensors"}, {}))
+                        continue
+                    req = batcher.submit(tensors)
+                    resp_q.put(("predict", seq, meta.get("compress"), req))
+                else:
+                    try:
+                        resp_meta, resp_tensors = self._control(
+                            batcher, meta)
+                    except Exception as exc:
+                        resp_meta = {"ok": False,
+                                     "error": f"{type(exc).__name__}: {exc}"}
+                        resp_tensors = {}
+                    resp_q.put(("done", seq, resp_meta, resp_tensors))
+        finally:
+            resp_q.put(None)
 
     @staticmethod
-    def _dispatch(batcher: Batcher, meta: dict, tensors: dict,
-                  server_meta: dict | None = None):
+    def _control(batcher: Batcher, meta: dict):
         op = meta.get("op")
         if op == "ping":
             return {"ok": True}, {}
         if op == "stats":
             return {"ok": True, **batcher.stats()}, {}
-        if op == "predict":
-            if not tensors:
-                return {"ok": False, "error": "no feed tensors"}, {}
-            req = batcher.submit(tensors)
-            req.done.wait()
-            if req.error is not None:
-                return {"ok": False, "error": req.error}, {}
-            out = req.result
-            compressed = {}
-            comp = meta.get("compress")
-            if comp:  # client-negotiated host-side top-k of dense outs
-                # never re-compress outputs the predict_fn already emits
-                # sparse (name.idx/name.val) — a smaller client K would
-                # otherwise shred name.val into name.val.idx/...
-                sparse = {k: v for k, v in out.items()
-                          if k.endswith((".idx", ".val"))}
-                frag, out = compress_outputs(
-                    {k: v for k, v in out.items() if k not in sparse},
-                    comp)
-                out.update(sparse)
-                compressed.update(frag.get("compressed", {}))
-            if server_meta:  # predict_fn emitted device-side sparse outs
-                compressed.update(
-                    {name: info for name, info in server_meta.items()
-                     if name + ".idx" in out})
-            if compressed:
-                return {"ok": True, "compressed": compressed}, out
-            return {"ok": True}, out
         return {"ok": False, "error": f"unknown op {op!r}"}, {}
+
+    @staticmethod
+    def _write_loop(sock: socket.socket, resp_q: queue.Queue,
+                    server_meta: dict) -> None:
+        broken = False   # after a send failure keep DRAINING (the recv
+        # loop's final sentinel put must never block on a full queue)
+        while True:
+            item = resp_q.get()
+            if item is None:
+                return
+            if broken:
+                continue
+            kind, seq, a, b = item
+            if kind == "predict":
+                req: _Request = b
+                req.done.wait()
+                if req.error is not None:
+                    resp_meta, out = {"ok": False, "error": req.error}, {}
+                else:
+                    try:
+                        resp_meta, out = _predict_response(
+                            req.result, a, server_meta)
+                    except Exception as exc:
+                        resp_meta = {"ok": False,
+                                     "error": f"{type(exc).__name__}: {exc}"}
+                        out = {}
+            else:
+                resp_meta, out = a, b
+            if seq is not None:
+                resp_meta = {**resp_meta, "seq": seq}
+            try:
+                tensor_wire.send_tensors(sock, resp_meta, out)
+            except OSError:
+                broken = True
 
 
 class _ThreadingServer(socketserver.ThreadingTCPServer):
@@ -342,7 +516,8 @@ class TeacherServer:
     def __init__(self, predict_fn, *, port: int = 0, host: str = "0.0.0.0",
                  max_batch: int = 64, max_wait: float = 0.002,
                  buckets: tuple[int, ...] = DEFAULT_BUCKETS,
-                 compressed_meta: dict[str, dict] | None = None):
+                 compressed_meta: dict[str, dict] | None = None,
+                 max_wait_cap: float | None = None):
         """``compressed_meta``: announce that `predict_fn` ALREADY emits
         sparse ``name.idx``/``name.val`` outputs (device-side
         ``lax.top_k`` — only K values ever cross host<->device instead
@@ -351,7 +526,8 @@ class TeacherServer:
         dense clients scatter-expand transparently while sparse clients
         consume as-is."""
         self.batcher = Batcher(predict_fn, max_batch=max_batch,
-                               max_wait=max_wait, buckets=buckets)
+                               max_wait=max_wait, buckets=buckets,
+                               max_wait_cap=max_wait_cap)
         self.compressed_meta = dict(compressed_meta or {})
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.batcher = self.batcher  # type: ignore[attr-defined]
@@ -381,10 +557,52 @@ class TeacherServer:
         self.stop()
 
 
+class _PendingPredict:
+    """Handle for one in-flight request on a pipelined TeacherClient.
+    ``result()`` blocks until THIS request's response arrives (receiving
+    and completing any earlier in-flight requests along the way — the
+    server responds strictly in request order per connection)."""
+
+    __slots__ = ("_client", "seq", "_meta", "_tensors", "_arrived")
+
+    def __init__(self, client: "TeacherClient", seq: int):
+        self._client = client
+        self.seq = seq
+        self._meta: dict | None = None
+        self._tensors: dict | None = None
+        self._arrived = False
+
+    def response(self) -> tuple[dict, dict]:
+        """Raw (meta, tensors) of the response, no ok-check/expansion."""
+        self._client._wait_for(self)
+        return self._meta, self._tensors  # type: ignore[return-value]
+
+    def result(self) -> dict[str, np.ndarray]:
+        """Predict semantics: raise on server error, expand per the
+        client's negotiation settings."""
+        meta, tensors = self.response()
+        if not meta.get("ok"):
+            raise tensor_wire.TensorWireError(
+                meta.get("error", "predict failed"))
+        if self._client.expand:
+            tensors = expand_outputs(meta, tensors)
+        return tensors
+
+
 class TeacherClient:
-    """Blocking client of one teacher server (used by DistillReader's
-    predict workers; the reference counterpart wraps paddle_serving_client,
+    """Client of one teacher server (used by DistillReader's predict
+    workers; the reference counterpart wraps paddle_serving_client,
     distill_worker.py:187-282).
+
+    ``predict`` is the blocking one-shot; ``predict_async`` returns a
+    `_PendingPredict` handle and may be called again before resolving it,
+    keeping up to ``max_inflight`` requests pipelined on the ONE
+    connection — the r6 lever that hides teacher round-trip latency under
+    student compute. Requests are sequence-tagged and the server echoes
+    the tag; a FIFO mismatch fails loudly instead of silently pairing a
+    response with the wrong request. Not thread-safe by design: each
+    reader worker owns its client (a lock still guards the send path for
+    accidental sharing).
 
     ``compress_topk > 0`` negotiates top-k+fp16 logit compression per
     request (see `compress_outputs`); with ``expand=True`` (default) the
@@ -395,48 +613,78 @@ class TeacherClient:
 
     def __init__(self, endpoint: str, timeout: float = 30.0, *,
                  compress_topk: int = 0, compress_values: str = "float16",
-                 expand: bool = True):
+                 expand: bool = True, max_inflight: int = 32):
         from edl_tpu.utils.net import split_endpoint
         self.endpoint = endpoint
         self.compress_topk = int(compress_topk)
         self.compress_values = compress_values
         self.expand = expand
+        self.max_inflight = max(1, int(max_inflight))
         host, port = split_endpoint(endpoint)
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._seq = 0
+        self._pending: "deque[_PendingPredict]" = deque()
+        self._send_lock = threading.Lock()
 
-    def predict(self, feeds: dict[str, np.ndarray]
-                ) -> dict[str, np.ndarray]:
+    def _submit(self, meta: dict, tensors: dict | None = None
+                ) -> _PendingPredict:
+        with self._send_lock:
+            if len(self._pending) >= self.max_inflight:
+                self._recv_one()   # bound memory: drain the oldest
+            handle = _PendingPredict(self, self._seq)
+            self._seq += 1
+            tensor_wire.send_tensors(self._sock,
+                                     {**meta, "seq": handle.seq}, tensors)
+            self._pending.append(handle)
+        return handle
+
+    def _recv_one(self) -> None:
+        meta, tensors = tensor_wire.recv_tensors(self._sock)
+        if not self._pending:
+            raise tensor_wire.TensorWireError(
+                "response with no request in flight")
+        h = self._pending.popleft()
+        rseq = meta.get("seq")
+        if rseq is not None and rseq != h.seq:
+            raise tensor_wire.TensorWireError(
+                f"pipelining desync: response seq {rseq} != expected "
+                f"{h.seq} on {self.endpoint}")
+        h._meta, h._tensors, h._arrived = meta, tensors, True
+
+    def _wait_for(self, handle: _PendingPredict) -> None:
+        while not handle._arrived:
+            self._recv_one()
+
+    def inflight(self) -> int:
+        return len(self._pending)
+
+    def predict_async(self, feeds: dict[str, np.ndarray]) -> _PendingPredict:
         meta: dict = {"op": "predict"}
         if self.compress_topk > 0:
             meta["compress"] = {"topk": self.compress_topk,
                                 "values": self.compress_values}
-        tensor_wire.send_tensors(self._sock, meta, feeds)
-        meta, tensors = tensor_wire.recv_tensors(self._sock)
-        if not meta.get("ok"):
-            raise tensor_wire.TensorWireError(
-                meta.get("error", "predict failed"))
-        if self.expand:
-            tensors = expand_outputs(meta, tensors)
-        return tensors
+        return self._submit(meta, feeds)
+
+    def predict(self, feeds: dict[str, np.ndarray]
+                ) -> dict[str, np.ndarray]:
+        return self.predict_async(feeds).result()
 
     def ping(self) -> bool:
         try:
-            tensor_wire.send_tensors(self._sock, {"op": "ping"})
-            meta, _ = tensor_wire.recv_tensors(self._sock)
+            meta, _ = self._submit({"op": "ping"}).response()
             return bool(meta.get("ok"))
         except (tensor_wire.TensorWireError, OSError):
             return False
 
     def stats(self) -> dict:
         """Serving counters of the remote teacher (op: stats)."""
-        tensor_wire.send_tensors(self._sock, {"op": "stats"})
-        meta, _ = tensor_wire.recv_tensors(self._sock)
+        meta, _ = self._submit({"op": "stats"}).response()
         if not meta.get("ok"):
             raise tensor_wire.TensorWireError(
                 meta.get("error", "stats failed"))
-        return {k: v for k, v in meta.items() if k != "ok"}
+        return {k: v for k, v in meta.items() if k not in ("ok", "seq")}
 
     def close(self) -> None:
         try:
@@ -540,22 +788,25 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
         if serve_topk:
             from jax import lax
             val, idx = lax.top_k(logits.astype(jnp.float32), serve_topk)
-            return idx.astype(jnp.int32), val
-        return logits
+            # wire dtypes ON DEVICE: the batcher's complete stage only
+            # fetches, never converts
+            return idx.astype(jnp.int32), val.astype(jnp.float16)
+        return logits.astype(jnp.float32)
 
+    # device arrays are returned UNFETCHED: jit dispatch is async, so the
+    # batcher's compute thread immediately feeds the chip the next
+    # coalesced batch while the complete stage pulls these to host.
     if serve_topk:
         def predict(feeds):
             feed = jnp.asarray(feeds[input_key]).astype(
                 jnp.dtype(input_dtype))
             idx, val = forward(feed)
-            return {output_key + ".idx": np.asarray(idx, np.int32),
-                    output_key + ".val":
-                        np.asarray(val).astype(np.float16)}
+            return {output_key + ".idx": idx, output_key + ".val": val}
     else:
         def predict(feeds):
             feed = jnp.asarray(feeds[input_key]).astype(
                 jnp.dtype(input_dtype))
-            return {output_key: np.asarray(forward(feed), np.float32)}
+            return {output_key: forward(feed)}
 
     meta = None
     if serve_topk:

@@ -42,6 +42,35 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+# sendmsg is limited to IOV_MAX iovecs per call (1024 on Linux); far
+# smaller batches already amortize the syscall, and short slices keep the
+# per-call bookkeeping cheap.
+_IOV_BATCH = 64
+
+
+def _send_gather(sock: socket.socket, bufs: list) -> None:
+    """writev-style gather send: one syscall over many buffers instead of
+    one concatenated copy of the whole frame (the old path built
+    ``b"".join(payloads)`` — a full extra copy of every tensor on the hot
+    serving path)."""
+    if not hasattr(sock, "sendmsg"):  # non-POSIX fallback
+        for b in bufs:
+            sock.sendall(b)
+        return
+    # nbytes-filter BEFORE the cast: zero-size views (empty tensors) reject
+    # cast("B"), and zero-length iovecs are pure overhead anyway.
+    views = [memoryview(b).cast("B") for b in bufs
+             if memoryview(b).nbytes]
+    while views:
+        sent = sock.sendmsg(views[:_IOV_BATCH])
+        # sendmsg on a blocking socket may still send partially: advance.
+        while views and sent >= len(views[0]):
+            sent -= len(views[0])
+            views.pop(0)
+        if views and sent:
+            views[0] = views[0][sent:]
+
+
 def send_tensors(sock: socket.socket, meta: dict[str, Any],
                  tensors: dict[str, np.ndarray] | None = None) -> None:
     tensors = tensors or {}
@@ -56,12 +85,13 @@ def send_tensors(sock: socket.socket, meta: dict[str, Any],
                 f"non-wire dtype {arr.dtype} for tensor {name!r}")
         descs.append({"name": name, "dtype": arr.dtype.str,
                       "shape": list(arr.shape)})
-        payloads.append(arr.tobytes())
+        # zero-copy: the array's own buffer rides the gather send
+        payloads.append(arr.data)
     header = json.dumps({"meta": meta, "tensors": descs},
                         separators=(",", ":")).encode("utf-8")
     if len(header) > MAX_HEADER:
         raise TensorWireError(f"header too large: {len(header)}")
-    sock.sendall(_HEADER.pack(MAGIC, len(header)) + header + b"".join(payloads))
+    _send_gather(sock, [_HEADER.pack(MAGIC, len(header)), header, *payloads])
 
 
 def recv_tensors(sock: socket.socket

@@ -90,9 +90,10 @@ class TransformerConfig:
                       if self.mesh.shape.get(a, 1) > 1) or None
         spec = P(batch)
         fn = partial(flash_attention, causal=True)
-        return jax.shard_map(fn, mesh=self.mesh,
-                             in_specs=(spec, spec, spec), out_specs=spec,
-                             check_vma=False)(q, k, v)
+        from edl_tpu.parallel.compat import shard_map
+        return shard_map(fn, mesh=self.mesh,
+                         in_specs=(spec, spec, spec), out_specs=spec,
+                         check_vma=False)(q, k, v)
 
 
 def _dense(features, names, cfg, name=None):

@@ -167,8 +167,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     body = _local_ring_flash if use_flash else _local_ring_attention
     fn = functools.partial(body, axis_name=sp_axis,
                            causal=causal, scale=scale)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from edl_tpu.parallel.compat import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
 
 
 def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,

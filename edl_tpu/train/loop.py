@@ -48,6 +48,11 @@ class LoopConfig:
     profile_dir: str | None = field(None, env="EDL_TPU_PROFILE_DIR")
     profile_start_step: int = field(10, env="EDL_TPU_PROFILE_START")
     profile_steps: int = field(5, env="EDL_TPU_PROFILE_STEPS")
+    # Host->device prefetch: stage up to N placed batches on a daemon
+    # thread while the current step computes, so the device_put of batch
+    # i+1 hides under step i (H2D overlap — the distill serving path's
+    # student-side half). 0 = place inline on the training thread.
+    prefetch_batches: int = field(0, env="EDL_TPU_PREFETCH_BATCHES")
 
 
 class TrainLoop:
@@ -202,6 +207,31 @@ class TrainLoop:
             self._profiling = False
             log.info("profiler: trace written to %s", cfg.profile_dir)
 
+    def _epoch_iter(self, src, skip: int):
+        """(index, device-placed batch) pairs starting at ``skip``.
+
+        Skipping happens BEFORE placement so a mid-epoch resume never
+        transfers already-trained batches. With ``prefetch_batches > 0``
+        placement runs on a staging thread `prefetch_batches` deep, so
+        the host->device copy of batch i+1 hides under step i.
+        """
+        end = object()
+        it = iter(src)
+        for _ in range(skip):
+            if next(it, end) is end:
+                return
+        if self.config.prefetch_batches > 0:
+            from edl_tpu.data.pipeline import prefetch
+            staged = prefetch(it, size=self.config.prefetch_batches,
+                              place=self._place)
+            try:
+                yield from enumerate(staged, start=skip)
+            finally:
+                staged.close()
+        else:
+            for i, batch in enumerate(it, start=skip):
+                yield i, self._place(batch)
+
     def _run_epoch(self, epoch: int, data_fn, batch_size_fn) -> None:
         cfg = self.config
         window_start = time.perf_counter()
@@ -216,11 +246,10 @@ class TrainLoop:
         if skip:
             log.info("resuming mid-epoch: skipping %d already-trained "
                      "batches of epoch %d", skip, epoch)
-        for i, batch in enumerate(data_fn(epoch)):
-            if i < skip:
-                continue
+        src = data_fn(epoch)
+        it = self._epoch_iter(src, skip)
+        for i, batch in it:
             self._profile_window()
-            batch = self._place(batch)
             self.state, metrics = self.step_fn(self.state, batch)
             self.status.step += 1
             self.status.step_in_epoch = i + 1

@@ -20,12 +20,24 @@ os.environ.setdefault("EDL_TPU_TEST_DEVICES", "8")
 # the SAME platform, not a sitecustomize tunnel backend.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_NUM_CPU_DEVICES"] = os.environ["EDL_TPU_TEST_DEVICES"]
+# jax < 0.5 has no jax_num_cpu_devices option; the XLA flag is the
+# portable spelling of the same virtual-device fan-out (read at backend
+# init, so setting it here is still early enough).
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count="
+        + os.environ["EDL_TPU_TEST_DEVICES"]).strip()
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", int(os.environ["EDL_TPU_TEST_DEVICES"]))
+try:
+    jax.config.update("jax_num_cpu_devices",
+                      int(os.environ["EDL_TPU_TEST_DEVICES"]))
+except AttributeError:  # jax < 0.5: XLA_FLAGS above already applies
+    pass
 
 
 # -- test tiers ------------------------------------------------------------

@@ -128,6 +128,7 @@ class TestUtilizationPublisher:
         loop = self._Loop()
         loop.status.samples_seen = 128
         pub(loop, epoch=0, step=10, metrics={})
+        assert pub.flush()   # r6: store writes ride a background thread
         rec = store.get(util_key("j1", "podA"))
         doc = json.loads(rec.value)
         assert doc["samples_seen"] == 128 and doc["rank"] == 1
@@ -135,6 +136,7 @@ class TestUtilizationPublisher:
         assert rec.lease  # leased: stale records self-clean
         loop.status.samples_seen = 256
         pub(loop, epoch=0, step=20, metrics={})
+        assert pub.flush()
         doc = json.loads(store.get(util_key("j1", "podA")).value)
         assert doc["samples_seen"] == 256
         assert doc["examples_per_sec"] > 0
@@ -149,6 +151,51 @@ class TestUtilizationPublisher:
         pub = UtilizationPublisher(_Broken(), "j", "p", min_interval=0.0)
         loop = self._Loop()
         pub(loop, 0, 1, {})  # must swallow, training goes on
+        pub.flush()
+        pub.stop()
+
+    def test_hung_store_never_stalls_training_thread(self):
+        """The r6 redesign's acceptance: __call__ does NO store I/O, so
+        a store hanging for seconds costs the train step nothing (before,
+        every log point rode the store's multi-second timeout)."""
+        class _Hung:
+            def lease_grant(self, ttl):
+                time.sleep(0.5)
+                raise OSError("store hung then down")
+
+        pub = UtilizationPublisher(_Hung(), "j", "p", min_interval=0.0)
+        loop = self._Loop()
+        t0 = time.monotonic()
+        for step in range(20):
+            pub(loop, 0, step, {})
+        assert time.monotonic() - t0 < 0.4  # never blocked on the store
+        pub.stop()
+
+    def test_drop_latest_keeps_newest_snapshot(self):
+        """A stalled publisher drops OLD snapshots: after it unwedges,
+        the stored record is the newest one, not a backlog replay."""
+        store = InMemStore()
+        gate = time.monotonic() + 0.3
+
+        class _SlowStore:
+            def lease_grant(self, ttl):
+                while time.monotonic() < gate:   # wedge the first put
+                    time.sleep(0.01)
+                return store.lease_grant(ttl)
+
+            def __getattr__(self, name):  # keepalive/put/... pass through
+                return getattr(store, name)
+
+        pub = UtilizationPublisher(_SlowStore(), "j1", "podA",
+                                   min_interval=0.0)
+        loop = self._Loop()
+        for step in range(1, 6):
+            loop.status.samples_seen = 128 * step
+            pub(loop, 0, step, {})
+            time.sleep(0.02)
+        assert pub.flush()
+        doc = json.loads(store.get(util_key("j1", "podA")).value)
+        assert doc["step"] == 5   # latest wins
         pub.stop()
 
     def test_from_env_requires_launcher_context(self, monkeypatch):
@@ -190,6 +237,7 @@ def test_publisher_as_trainloop_hook_end_to_end():
                      hooks=[pub])
     loop.run(lambda epoch: iter(batches))
     # stop() ran inside run()? no — explicit hooks are caller-owned
+    assert pub.flush()
     doc = json.loads(store.get(util_key("jobX", "podX")).value)
     assert doc["samples_seen"] == 32
     pub.stop()

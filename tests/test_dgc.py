@@ -186,7 +186,8 @@ class TestSparsePsum:
                                  keep_frac=keep_frac)["w"]
             return summed[None]  # (1, n) slab per worker -> (8, n) global
 
-        out = jax.jit(jax.shard_map(
+        from edl_tpu.parallel.compat import shard_map
+        out = jax.jit(shard_map(
             body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
             check_vma=False))(g)
         return g, out
@@ -196,7 +197,8 @@ class TestSparsePsum:
         # every worker's slice holds the same dense sum
         want = jnp.sum(g, axis=0)
         for w in range(8):
-            np.testing.assert_allclose(out[w], want, rtol=1e-5)
+            # 2e-5: psum reduction order differs across jax versions
+            np.testing.assert_allclose(out[w], want, rtol=2e-5)
 
     def test_topk_contributions_only(self):
         """Each worker contributes exactly its k largest-|.| entries."""

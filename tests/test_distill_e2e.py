@@ -153,6 +153,9 @@ def test_inflight_window_grows_when_teacher_joins():
         predicts = ("p",)
         _wire_predicts = ("p",)
         max_retries = 3
+        pipeline_depth = 1              # depth 1 = the classic 2n+2 window
+        compress_topk = 0
+        sparse_predicts = False
         _client_factory = staticmethod(lambda ep: None)
 
         @staticmethod
@@ -160,7 +163,7 @@ def test_inflight_window_grows_when_teacher_joins():
             return ["t0"]
 
     p = _EpochPipeline(_FakeReader())
-    assert p._sem_slots == 4            # 2*1+2
+    assert p._sem_slots == 4            # (1+1)*1+2
     p.resize_window(3)
     assert p._sem_slots == 8            # 2*3+2
     # 8 acquires must now succeed without blocking.

@@ -1,0 +1,384 @@
+"""The r6 overlapped serving path: request pipelining, staged batcher,
+adaptive coalescing, gather-send wire, and the satellite contracts
+(dead-teacher pruning, per-part top-k validation, jax-free wire import).
+
+Invariant focus: D1-D3 must survive pipelining — responses must pair
+with THEIR requests after a worker dies with several in flight, and the
+reader must still yield in source order with depth > 1.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from edl_tpu.distill import tensor_wire
+from edl_tpu.distill.reader import (DistillReader, EdlDistillError,
+                                    _EpochPipeline)
+from edl_tpu.distill.teacher_server import (Batcher, TeacherClient,
+                                            TeacherServer)
+from tests.test_distill_reader import (check_epoch, make_batches,
+                                       ref_logits, _FnTeacherClient)
+
+
+# -- pipelined fake teachers (no network, value-checkable) -----------------
+
+class _AsyncHandle:
+    def __init__(self, client, feeds):
+        self._client = client
+        self._feeds = feeds
+
+    def result(self):
+        c = self._client
+        c.resolved += 1
+        if c.fail_after is not None and c.resolved > c.fail_after:
+            raise ConnectionError("teacher died mid-flight")
+        if c.delay:
+            time.sleep(c.delay)
+        return {"teacher_logits": ref_logits(self._feeds["image"])}
+
+
+class _AsyncFnTeacherClient:
+    """predict_async-capable fake: the worker pipelines against it.
+    ``fail_after=N``: the connection dies when resolving result N+1 —
+    with depth > 1 several requests are in flight at that moment."""
+
+    def __init__(self, endpoint, delay=0.0, fail_after=None):
+        self.endpoint = endpoint
+        self.delay = delay
+        self.fail_after = fail_after
+        self.resolved = 0
+        self.sent = 0
+        self.max_inflight_seen = 0
+
+    def predict_async(self, feeds):
+        self.sent += 1
+        self.max_inflight_seen = max(self.max_inflight_seen,
+                                     self.sent - self.resolved)
+        return _AsyncHandle(self, feeds)
+
+    def predict(self, feeds):
+        return self.predict_async(feeds).result()
+
+    def close(self):
+        pass
+
+
+# -- reader pipelining ------------------------------------------------------
+
+def test_reader_source_order_with_depth():
+    """D2 regression with depth > 1: teachers of very different speeds,
+    several requests in flight each — batches still come back in source
+    order, values exact."""
+    delays = {"fast": 0.0, "slow": 0.02}
+    clients = {}
+
+    def factory(ep):
+        clients[ep] = _AsyncFnTeacherClient(ep, delay=delays[ep])
+        return clients[ep]
+
+    batches = make_batches(n_batches=8, rows=16)
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"],
+                       teachers=["fast", "slow"], teacher_batch_size=4,
+                       pipeline_depth=3, client_factory=factory)
+    check_epoch(batches, list(dr()))
+    # pipelining actually happened: some client held > 1 in flight
+    assert max(c.max_inflight_seen for c in clients.values()) > 1
+
+
+def test_pipelined_worker_death_requeues_all_inflight():
+    """D1+D3 under churn: a teacher dies while holding several in-flight
+    requests; every one of them must be re-served by the survivor, each
+    response matching its request by value, order preserved."""
+    dying = {}
+
+    def factory(ep):
+        if ep == "dying":
+            dying[ep] = _AsyncFnTeacherClient(ep, delay=0.005,
+                                              fail_after=2)
+            return dying[ep]
+        return _AsyncFnTeacherClient(ep, delay=0.002)
+
+    batches = make_batches(n_batches=10, rows=16)
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"],
+                       teachers=["good", "dying"], teacher_batch_size=4,
+                       pipeline_depth=3, manage_interval=0.05,
+                       client_factory=factory)
+    check_epoch(batches, list(dr()))
+    # it really died holding work: more sent than resolved at death
+    assert dying["dying"].sent > dying["dying"].resolved
+
+
+def test_sync_only_client_still_works_at_depth():
+    """Clients without predict_async (the pre-r6 contract) degrade to
+    depth 1 — same pipeline, no behavior change."""
+    batches = make_batches(n_batches=4, rows=16)
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"], teachers=["t0"],
+                       teacher_batch_size=4, pipeline_depth=8,
+                       client_factory=lambda ep: _FnTeacherClient(ep))
+    check_epoch(batches, list(dr()))
+
+
+def test_window_scales_with_pipeline_depth():
+    dr = DistillReader(lambda: iter([]), feeds=["image"], predicts=["p"],
+                       teachers=["a", "b"], pipeline_depth=6,
+                       client_factory=lambda ep: _FnTeacherClient(ep))
+    p = _EpochPipeline(dr)
+    assert p._sem_slots == (6 + 1) * 2 + 2   # D5: (depth+1)*teachers+2
+
+
+# -- satellite: dead-teacher pruning ---------------------------------------
+
+def test_departed_dead_teacher_pruned_no_deadman():
+    """Discovery mode: a teacher that died AND was removed from the
+    assignment must not permanently trip the deadman (the D6 docstring's
+    scale-to-zero promise) — the epoch waits for the balancer and a
+    later teacher completes it."""
+    batches = make_batches(n_batches=2, rows=8)
+    start = time.monotonic()
+
+    def servers():
+        t = time.monotonic() - start
+        if t < 0.3:
+            return ["dead"]      # assigned but connect-refusing
+        if t < 1.2:
+            return []            # departed AND removed from assignment
+        return ["good"]
+
+    def factory(ep):
+        if ep == "dead":
+            raise ConnectionRefusedError("refused")
+        return _FnTeacherClient(ep)
+
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"],
+                       discovery="unused:0", service="svc",
+                       teacher_batch_size=4, manage_interval=0.05,
+                       deadman_timeout=0.8, client_factory=factory)
+    dr._get_servers = servers
+    # without pruning, dead_teachers["dead"] keeps empty_pool_ok False
+    # and the deadman trips at ~0.8s < the 1.2s empty window
+    check_epoch(batches, list(dr()))
+
+
+# -- satellite: per-part top-k validation ----------------------------------
+
+def test_sparse_topk_mismatch_names_endpoint():
+    class _WrongK:
+        def __init__(self, ep):
+            self.endpoint = ep
+
+        def predict(self, feeds):
+            rows = len(feeds["image"])
+            return {"teacher_logits.idx": np.zeros((rows, 2), np.int32),
+                    "teacher_logits.val": np.zeros((rows, 2), np.float16)}
+
+        def close(self):
+            pass
+
+    batches = make_batches(n_batches=2, rows=8)
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"], teachers=["t0:1"],
+                       teacher_batch_size=4, compress_topk=4,
+                       sparse_predicts=True,
+                       client_factory=lambda ep: _WrongK(ep))
+    with pytest.raises(EdlDistillError) as ei:
+        list(dr())
+    msg = str(ei.value)
+    assert "t0:1" in msg            # names the offending endpoint
+    assert "top-2" in msg and "4" in msg
+
+
+# -- client/server pipelining over real TCP --------------------------------
+
+@pytest.fixture
+def echo_teacher():
+    def predict(feeds):
+        return {"teacher_logits": ref_logits(feeds["image"])}
+    with TeacherServer(predict, host="127.0.0.1", max_wait=0.001) as srv:
+        yield f"127.0.0.1:{srv.port}"
+
+
+def test_client_pipelining_seq_roundtrip(echo_teacher):
+    c = TeacherClient(echo_teacher, max_inflight=16)
+    try:
+        feeds = [np.full((2, 3), float(i), np.float32) for i in range(8)]
+        handles = [c.predict_async({"image": f}) for f in feeds]
+        assert c.inflight() == 8
+        # a control op rides the same FIFO stream mid-flight
+        assert c.ping()
+        for f, h in zip(feeds, handles):
+            np.testing.assert_allclose(h.result()["teacher_logits"],
+                                       ref_logits(f), rtol=1e-6)
+        assert c.inflight() == 0
+        stats = c.stats()
+        assert stats["served_requests"] >= 8
+    finally:
+        c.close()
+
+
+def test_pipelined_responses_resolve_out_of_submission_order(echo_teacher):
+    """result() on a LATER handle first: earlier responses are absorbed
+    into their handles along the way and stay readable."""
+    c = TeacherClient(echo_teacher, max_inflight=8)
+    try:
+        feeds = [np.full((1, 4), float(i), np.float32) for i in range(4)]
+        handles = [c.predict_async({"image": f}) for f in feeds]
+        np.testing.assert_allclose(handles[3].result()["teacher_logits"],
+                                   ref_logits(feeds[3]), rtol=1e-6)
+        np.testing.assert_allclose(handles[0].result()["teacher_logits"],
+                                   ref_logits(feeds[0]), rtol=1e-6)
+    finally:
+        c.close()
+
+
+def test_reader_over_real_server_with_depth(echo_teacher):
+    batches = make_batches(n_batches=5, rows=24)
+    dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                       predicts=["teacher_logits"],
+                       teachers=[echo_teacher], teacher_batch_size=8,
+                       pipeline_depth=4)
+    check_epoch(batches, list(dr()))
+
+
+# -- adaptive coalescing ----------------------------------------------------
+
+def test_adaptive_coalesce_grows_batches_while_device_busy():
+    """While a group computes, newly arrived requests keep coalescing
+    past max_wait — the mean device batch must climb above the 4-row
+    request size (the r6 acceptance: mean climbs off one request)."""
+    def predict(feeds):
+        time.sleep(0.004)   # a busy device
+        return {"y": feeds["x"]}
+
+    b = Batcher(predict, max_batch=32, max_wait=0.0005).start()
+    try:
+        errs = []
+
+        def runner():
+            for _ in range(8):
+                r = b.submit({"x": np.ones((4, 2), np.float32)})
+                r.done.wait(10.0)
+                if r.error is not None or r.result["y"].shape != (4, 2):
+                    errs.append(r.error or "bad shape")
+                    return
+
+        threads = [threading.Thread(target=runner) for _ in range(4)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert not errs
+        stats = b.stats()
+        assert stats["served_rows"] == 4 * 8 * 4
+        assert stats["batch_rows_mean"] > 4.0, stats
+        assert stats["pending_hwm"] >= 2
+    finally:
+        b.stop()
+
+
+def test_staged_batcher_slices_results_exactly():
+    """Per-request result slicing across the staged pipeline: every
+    submitter gets ITS rows back (values, not just shapes)."""
+    def predict(feeds):
+        return {"y": feeds["x"] * 2.0}
+
+    b = Batcher(predict, max_batch=64, max_wait=0.05).start()
+    try:
+        reqs = []
+
+        def submit(i):
+            reqs.append((i, b.submit(
+                {"x": np.full((3, 2), float(i), np.float32)})))
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(6)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        for i, req in reqs:
+            req.done.wait(5.0)
+            assert req.error is None
+            np.testing.assert_allclose(req.result["y"],
+                                       np.full((3, 2), 2.0 * i))
+    finally:
+        b.stop()
+
+
+def test_batcher_failure_fails_only_that_group():
+    fail_on = {"flag": True}
+
+    def predict(feeds):
+        if fail_on["flag"]:
+            raise RuntimeError("boom")
+        return {"y": feeds["x"]}
+
+    b = Batcher(predict, max_batch=8, max_wait=0.001).start()
+    try:
+        r1 = b.submit({"x": np.ones((2, 2), np.float32)})
+        r1.done.wait(5.0)
+        assert r1.error is not None and "boom" in r1.error
+        fail_on["flag"] = False
+        r2 = b.submit({"x": np.ones((2, 2), np.float32)})
+        r2.done.wait(5.0)
+        assert r2.error is None
+    finally:
+        b.stop()
+
+
+# -- tensor wire gather send ------------------------------------------------
+
+def test_wire_gather_send_roundtrip():
+    a, b = socket.socketpair()
+    tensors = {
+        "big": np.arange(300000, dtype=np.float32).reshape(500, 600),
+        "empty": np.zeros((0, 5), np.float32),
+        "scalar": np.array(7, np.int64),
+        "noncontig": np.arange(100, dtype=np.float32).reshape(10, 10).T,
+        "u8": np.arange(16, dtype=np.uint8),
+    }
+    got = {}
+
+    def rx():
+        got["meta"], got["tensors"] = tensor_wire.recv_tensors(b)
+
+    th = threading.Thread(target=rx)
+    th.start()
+    tensor_wire.send_tensors(a, {"op": "x", "seq": 3}, tensors)
+    th.join(10.0)
+    assert not th.is_alive()
+    assert got["meta"]["seq"] == 3
+    for name, want in tensors.items():
+        np.testing.assert_array_equal(got["tensors"][name], want)
+    a.close()
+    b.close()
+
+
+# -- satellite: wire-only import stays jax-free ----------------------------
+
+def test_distill_import_is_jax_free():
+    """`import edl_tpu.distill` must work for wire-only consumers that
+    only need TeacherClient + numpy (sharded_teacher loads lazily)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import sys\n"
+        "pre = 'jax' in sys.modules\n"
+        "import edl_tpu.distill\n"
+        "from edl_tpu.distill import TeacherClient, DistillReader\n"
+        "if not pre:\n"
+        "    assert 'jax' not in sys.modules, 'distill pulled in jax'\n"
+        "import edl_tpu.distill as d\n"
+        "assert callable(d.sharded_predict_fn)\n"   # lazy path resolves
+        "print('OK')\n")
+    env = {**os.environ,
+           "PYTHONPATH": root + os.pathsep + os.environ.get("PYTHONPATH",
+                                                            "")}
+    out = subprocess.run([sys.executable, "-c", code], cwd=root, env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "OK" in out.stdout

@@ -176,3 +176,67 @@ def test_watcher_survives_callback_exception():
     reg.register_permanent("svc", "b:2")
     assert ev.wait(2.0), "watcher thread died after callback exception"
     w.stop()
+
+
+def test_prefetch_matches_inline_placement():
+    """prefetch_batches stages placed batches on a thread; the training
+    result must be identical to inline placement (same data, same step
+    order, same final params)."""
+    import jax
+
+    cfg = fit_a_line.Config(num_epochs=2, steps_per_epoch=12)
+
+    def run(prefetch):
+        state, step_fn = fit_a_line.build(cfg)
+        loop = TrainLoop(step_fn, state, mesh=make_mesh(),
+                         config=LoopConfig(num_epochs=2,
+                                           log_every_steps=1000,
+                                           prefetch_batches=prefetch))
+        loop.run(lambda e: fit_a_line.synthetic_batches(e, cfg))
+        return loop
+
+    inline, staged = run(0), run(2)
+    assert staged.status.step == inline.status.step
+    assert staged.status.samples_seen == inline.status.samples_seen
+    for a, b in zip(jax.tree.leaves(inline.state.params),
+                    jax.tree.leaves(staged.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+
+
+def test_prefetch_with_midepoch_resume(tmp_path):
+    """Skip-before-place: a mid-epoch resume with prefetch on must not
+    re-train (or even stage) already-trained batches."""
+    cfg = fit_a_line.Config(num_epochs=1, steps_per_epoch=10)
+    state, step_fn = fit_a_line.build(cfg)
+    loop1 = TrainLoop(step_fn, state, mesh=make_mesh(),
+                      config=LoopConfig(num_epochs=1, ckpt_dir=str(tmp_path),
+                                        ckpt_every_steps=4,
+                                        prefetch_batches=2))
+
+    class Crash(Exception):
+        pass
+
+    def crashing_data(epoch):
+        for i, b in enumerate(fit_a_line.synthetic_batches(epoch, cfg)):
+            if i == 6:
+                raise Crash()
+            yield b
+
+    with pytest.raises(Crash):
+        loop1.run(crashing_data)
+
+    trained = []
+    state2, step_fn2 = fit_a_line.build(cfg)
+
+    def tracking_step(state, batch):
+        trained.append(1)
+        return step_fn2(state, batch)
+
+    loop2 = TrainLoop(tracking_step, state2, mesh=make_mesh(),
+                      config=LoopConfig(num_epochs=1, ckpt_dir=str(tmp_path),
+                                        ckpt_every_steps=4,
+                                        prefetch_batches=2))
+    loop2.run(lambda e: fit_a_line.synthetic_batches(e, cfg))
+    assert len(trained) == 6        # batches 4..9 only
+    assert loop2.status.step == 10
