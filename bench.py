@@ -337,9 +337,24 @@ def bench_transformer(on_tpu: bool) -> dict:
             "mfu_large": large["mfu"]}
 
 
+def _median_run(fn, n: int = 3) -> tuple:
+    """Run a (rate, aux) measurement n times; return the median-rate
+    run's (rate, aux) + [min, max] spread. Wire-touching numbers through
+    this harness's tunnel are volatile (r5 captured 57-92 img/s across
+    rounds on one path); a single trial is not an artifact of record."""
+    runs = [fn() for _ in range(n)]
+    runs.sort(key=lambda r: r[0])
+    rate, aux = runs[n // 2]
+    return rate, aux, [round(runs[0][0], 1), round(runs[-1][0], 1)]
+
+
 def bench_distill(on_tpu: bool) -> dict:
     """Distill numbers: co-located e2e + the two bounds that support the
     disaggregated headline on hardware this harness doesn't have.
+
+    Every wire-touching number is a MEDIAN OF 3 runs with [min, max]
+    spread — the serving path rides real TCP + the host<->chip tunnel,
+    and the r5 driver capture proved single trials unstable.
 
     - e2e: student train + in-chip teacher over the real stack
       (DistillReader threads, TCP tensor wire, coalescing batcher) —
@@ -366,9 +381,9 @@ def bench_distill(on_tpu: bool) -> dict:
     if on_tpu:
         student = ResNet50_vd(num_classes=1000, dtype=jnp.bfloat16)
         teacher = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-        # 20 timed steps: the e2e number includes real TCP + host<->chip
-        # transfer, which is noisy through the tunnel — average longer
-        per_dev_batch, hw, classes, steps = 128, 224, 1000, 20
+        # 12 timed steps x 3 runs: median-of-3 replaces the old single
+        # 20-step trial — same wall budget, a spread in the artifact
+        per_dev_batch, hw, classes, steps = 128, 224, 1000, 12
         source_n, teacher_bs = 256, 16
     else:
         student = ResNetTiny(num_classes=10, dtype=jnp.float32)
@@ -424,8 +439,10 @@ def bench_distill(on_tpu: bool) -> dict:
     for b in (teacher_bs, 2 * teacher_bs, 4 * teacher_bs):
         tpredict({"image": np.zeros((b, hw, hw, 3), np.uint8)})
 
-    state = cls.create_state(student, jax.random.PRNGKey(0), (1, hw, hw, 3),
-                             optax.sgd(0.1, momentum=0.9, nesterov=True))
+    def fresh_student():
+        return cls.create_state(student, jax.random.PRNGKey(0),
+                                (1, hw, hw, 3),
+                                optax.sgd(0.1, momentum=0.9, nesterov=True))
 
     def distill_loss(state, params, batch):
         # soft-label CE against the teacher's TOP-K logits (reference
@@ -458,10 +475,12 @@ def bench_distill(on_tpu: bool) -> dict:
     # host->device double-buffer depth for the next distill batch
     pipe_depth = 8 if on_tpu else 4
 
-    def student_run(predict_fn, state):
+    def student_run(predict_fn):
         """The full student pipeline against `predict_fn` as the
-        teacher; returns (img/s, batcher stats)."""
+        teacher (fresh student state per run — the step donates it);
+        returns (img/s, batcher stats)."""
         from edl_tpu.data.pipeline import prefetch_to_device
+        state = fresh_student()
         server = TeacherServer(predict_fn, max_batch=4 * teacher_bs,
                                buckets=(teacher_bs, 2 * teacher_bs,
                                         4 * teacher_bs),
@@ -524,8 +543,9 @@ def bench_distill(on_tpu: bool) -> dict:
     teacher_chip = (chip_steps * 4 * teacher_bs
                     / (time.perf_counter() - t0) / n_dev)
 
-    # -- e2e: real teacher sharing this chip ------------------------------
-    imgs_per_sec, bstats = student_run(tpredict, state)
+    # -- e2e: real teacher sharing this chip (median of 3) ----------------
+    imgs_per_sec, bstats, e2e_spread = _median_run(
+        lambda: student_run(tpredict))
 
     # -- student-side ceiling: NOP teacher (reference _NOP_PREDICT_TEST) --
     def nop_predict(feeds):
@@ -533,10 +553,8 @@ def bench_distill(on_tpu: bool) -> dict:
         return {"logits.idx": np.zeros((rows, serve_topk), np.int32),
                 "logits.val": np.zeros((rows, serve_topk), np.float16)}
 
-    state2 = cls.create_state(student, jax.random.PRNGKey(0),
-                              (1, hw, hw, 3),
-                              optax.sgd(0.1, momentum=0.9, nesterov=True))
-    ceiling_imgs_per_sec, _ = student_run(nop_predict, state2)
+    ceiling_imgs_per_sec, _, ceiling_spread = _median_run(
+        lambda: student_run(nop_predict))
 
     # -- teacher-only capacity: concurrent clients, no student train ------
     import threading
@@ -545,63 +563,70 @@ def bench_distill(on_tpu: bool) -> dict:
 
     from collections import deque
 
-    server = TeacherServer(tpredict, max_batch=4 * teacher_bs,
-                           buckets=(teacher_bs, 2 * teacher_bs,
-                                    4 * teacher_bs),
-                           compressed_meta=compressed_meta).start()
-    try:
-        endpoint = f"127.0.0.1:{server.port}"
-        n_clients, reqs_per_client = 4, max(4, 2 * steps)
-        img = np.zeros((teacher_bs, hw, hw, 3), np.uint8)
-        # warm the serving path end-to-end before timing
-        c0 = TeacherClient(endpoint, timeout=120.0, expand=False)
-        c0.predict({"image": img})
-        c0.close()
-        served, client_errs = [], []
+    def teacher_only_run():
+        server = TeacherServer(tpredict, max_batch=4 * teacher_bs,
+                               buckets=(teacher_bs, 2 * teacher_bs,
+                                        4 * teacher_bs),
+                               compressed_meta=compressed_meta).start()
+        try:
+            endpoint = f"127.0.0.1:{server.port}"
+            n_clients, reqs_per_client = 4, max(4, 2 * steps)
+            img = np.zeros((teacher_bs, hw, hw, 3), np.uint8)
+            # warm the serving path end-to-end before timing
+            c0 = TeacherClient(endpoint, timeout=120.0, expand=False)
+            c0.predict({"image": img})
+            c0.close()
+            served, client_errs = [], []
 
-        def client():
-            # r6: pipelined — keep pipe_depth requests in flight per
-            # connection so the wire decode/encode, coalesce, chip
-            # compute, and host fetch stages all stay busy at once
-            try:
-                c = TeacherClient(endpoint, timeout=120.0, expand=False,
-                                  max_inflight=pipe_depth)
-                n = 0
-                handles = deque()
-                for _ in range(reqs_per_client):
-                    if len(handles) >= pipe_depth:
+            def client():
+                # r6: pipelined — keep pipe_depth requests in flight per
+                # connection so the wire decode/encode, coalesce, chip
+                # compute, and host fetch stages all stay busy at once
+                try:
+                    c = TeacherClient(endpoint, timeout=120.0, expand=False,
+                                      max_inflight=pipe_depth)
+                    n = 0
+                    handles = deque()
+                    for _ in range(reqs_per_client):
+                        if len(handles) >= pipe_depth:
+                            n += len(
+                                handles.popleft().result()["logits.idx"])
+                        handles.append(c.predict_async({"image": img}))
+                    while handles:
                         n += len(handles.popleft().result()["logits.idx"])
-                    handles.append(c.predict_async({"image": img}))
-                while handles:
-                    n += len(handles.popleft().result()["logits.idx"])
-                c.close()
-                served.append(n)
-            except Exception as exc:  # noqa: BLE001 — re-raised below
-                client_errs.append(exc)
+                    c.close()
+                    served.append(n)
+                except Exception as exc:  # noqa: BLE001 — re-raised below
+                    client_errs.append(exc)
 
-        threads = [threading.Thread(target=client)
-                   for _ in range(n_clients)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        tdt = time.perf_counter() - t0
-        if client_errs or len(served) != n_clients:
-            # a silently-dead client would deflate the published number
-            raise RuntimeError(
-                f"teacher bench client failure ({len(served)}/"
-                f"{n_clients} finished): {client_errs[:1]}")
-        teacher_imgs_per_sec = sum(served) / tdt
-        serving_stats = server.batcher.stats()
-    finally:
-        server.stop()
+            threads = [threading.Thread(target=client)
+                       for _ in range(n_clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            tdt = time.perf_counter() - t0
+            if client_errs or len(served) != n_clients:
+                # a silently-dead client would deflate the published number
+                raise RuntimeError(
+                    f"teacher bench client failure ({len(served)}/"
+                    f"{n_clients} finished): {client_errs[:1]}")
+            return sum(served) / tdt, server.batcher.stats()
+        finally:
+            server.stop()
+
+    teacher_imgs_per_sec, serving_stats, teacher_spread = _median_run(
+        teacher_only_run)
 
     per_accel = imgs_per_sec / n_dev
     return {"imgs_per_sec": round(imgs_per_sec, 1),
+            "imgs_per_sec_spread": e2e_spread,
             "vs_colocated_baseline": round(per_accel / (656.0 / 8.0), 3),
             "student_ceiling_imgs_per_sec": round(ceiling_imgs_per_sec, 1),
+            "student_ceiling_spread": ceiling_spread,
             "teacher_imgs_per_sec": round(teacher_imgs_per_sec, 1),
+            "teacher_imgs_per_sec_spread": teacher_spread,
             "teacher_chip_imgs_per_sec": round(teacher_chip, 1),
             "coalesce_batch_rows_mean": bstats.get("batch_rows_mean", 0.0),
             "coalesce_batch_rows_hist": bstats.get("batch_rows_hist", {}),
@@ -620,6 +645,210 @@ def bench_distill(on_tpu: bool) -> dict:
             "wire_logits_bytes_dense": classes * 4,
             "wire_logits_bytes": serve_topk * 6,
             "serve_topk": serve_topk}
+
+
+def bench_hybrid_mesh(on_tpu: bool) -> dict:
+    """Hybrid ICI×DCN mesh vs flat mesh step time on the SAME devices.
+
+    The dp gradient allreduce is the one collective allowed to cross the
+    slice boundary (parallel/mesh.make_hybrid_mesh); this times a
+    dp-only ResNet train step on the flat mesh vs the 2-slice hybrid
+    layout. On real multi-slice TPU the hybrid layout is the comms win
+    (per-layer collectives never touch DCN); on a single-slice chip or
+    the CPU test world both layouts ride the same links, so PARITY
+    (ratio ~1.0) is the expected — and still load-bearing — result: it
+    proves the hybrid permutation costs nothing when there is no DCN to
+    avoid."""
+    from edl_tpu.models.resnet import ResNetTiny
+    from edl_tpu.parallel import mesh as mesh_lib
+    from edl_tpu.train import classification as cls
+    from edl_tpu.train.step import make_train_step
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 or n_dev % 2:
+        return {"flat_step_ms": None, "hybrid_step_ms": None,
+                "hybrid_vs_flat_step_ratio": None, "n_slices": 1}
+    per_dev_batch, hw, classes, steps = (32, 64, 100, 8) if on_tpu \
+        else (8, 32, 10, 4)
+    model = ResNetTiny(num_classes=classes,
+                       dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    rng = np.random.default_rng(3)
+    batch_np = {
+        "image": rng.integers(0, 256, size=(per_dev_batch * n_dev, hw, hw,
+                                            3), dtype=np.uint8),
+        "label": rng.integers(0, classes,
+                              size=(per_dev_batch * n_dev,)).astype(
+                                  np.int32)}
+    step = cls.make_classification_step(classes, smoothing=0.1,
+                                        donate=False)
+
+    def timed(mesh) -> float:
+        state = cls.create_state(model, jax.random.PRNGKey(0),
+                                 (1, hw, hw, 3),
+                                 optax.sgd(0.1, momentum=0.9))
+        batch = mesh_lib.shard_batch(mesh, batch_np)
+        for _ in range(2):
+            state, metrics = step(state, batch)
+        _sync(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        _sync(metrics["loss"])
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    spec = mesh_lib.MeshSpec({"dp": -1})
+    flat_ms = timed(mesh_lib.make_mesh(spec))
+    hybrid_ms = timed(mesh_lib.make_hybrid_mesh(
+        spec, mesh_lib.SliceTopology(2, n_dev // 2)))
+    return {"flat_step_ms": round(flat_ms, 2),
+            "hybrid_step_ms": round(hybrid_ms, 2),
+            "hybrid_vs_flat_step_ratio": round(flat_ms / hybrid_ms, 3),
+            "n_slices": 2}
+
+
+def bench_distill_churn(on_tpu: bool) -> dict:
+    """Distill throughput UNDER teacher churn (VERDICT r5 ask #6).
+
+    Two live teachers; after a steady phase one is KILLED mid-run (its
+    in-flight tasks requeue to the survivor — invariant D3), then
+    RE-ADDED on the same endpoint (the manage thread reconnects on its
+    next tick). Reports the steady rate, the post-kill dip, and how many
+    seconds until a full measurement window is back within 80% of
+    steady — the reference's elastic-distill headline is exactly this
+    scenario (40-teacher pool under churn)."""
+    from edl_tpu.data.pipeline import ArraySource, DataLoader
+    from edl_tpu.distill.reader import DistillReader
+    from edl_tpu.distill.teacher_server import TeacherServer
+    from edl_tpu.models.resnet import ResNetTiny
+    from edl_tpu.parallel import mesh as mesh_lib
+    from edl_tpu.train import classification as cls
+    from edl_tpu.train.step import make_train_step
+
+    n_dev = len(jax.devices())
+    hw, classes, serve_topk, teacher_bs = 32, 10, 4, 4
+    per_dev_batch = 8
+    steady_steps, churn_steps, rejoin_steps = (8, 10, 10) if on_tpu \
+        else (6, 6, 6)
+    batch_size = per_dev_batch * n_dev
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": n_dev}))
+    sharding = mesh_lib.data_sharding(mesh)
+
+    teacher = ResNetTiny(num_classes=classes, dtype=jnp.float32)
+    tstate = cls.create_state(teacher, jax.random.PRNGKey(7),
+                              (1, hw, hw, 3), optax.identity())
+
+    @jax.jit
+    def tforward_topk(images):
+        images = normalize_uint8(images)
+        variables = {"params": tstate.params}
+        if tstate.batch_stats is not None:
+            variables["batch_stats"] = tstate.batch_stats
+        val, idx = jax.lax.top_k(
+            tstate.apply_fn(variables, images,
+                            train=False).astype(jnp.float32), serve_topk)
+        return idx.astype(jnp.int32), val.astype(jnp.float16)
+
+    def tpredict(feeds):
+        idx, val = tforward_topk(jnp.asarray(feeds["image"]))
+        return {"logits.idx": idx, "logits.val": val}
+
+    compressed_meta = {"logits": {"topk": serve_topk, "classes": classes,
+                                  "values": "<f2"}}
+    for b in (teacher_bs, 2 * teacher_bs, 4 * teacher_bs):
+        tpredict({"image": np.zeros((b, hw, hw, 3), np.uint8)})
+
+    def new_server(port=0):
+        return TeacherServer(tpredict, port=port, max_batch=4 * teacher_bs,
+                             buckets=(teacher_bs, 2 * teacher_bs,
+                                      4 * teacher_bs),
+                             compressed_meta=compressed_meta).start()
+
+    server_a, server_b = new_server(), new_server()
+    port_a = server_a.port
+    endpoints = [f"127.0.0.1:{port_a}", f"127.0.0.1:{server_b.port}"]
+
+    rng = np.random.default_rng(4)
+    source = ArraySource({
+        "image": rng.integers(0, 256, size=(8 * batch_size, hw, hw, 3),
+                              dtype=np.uint8),
+        "label": rng.integers(0, classes,
+                              size=(8 * batch_size,)).astype(np.int32)})
+    loader = DataLoader(source, batch_size)
+
+    student = ResNetTiny(num_classes=classes, dtype=jnp.float32)
+    state = cls.create_state(student, jax.random.PRNGKey(0), (1, hw, hw, 3),
+                             optax.sgd(0.1, momentum=0.9))
+
+    def distill_loss(state, params, batch):
+        img = normalize_uint8(batch["image"])
+        variables = {"params": params}
+        if state.batch_stats is not None:
+            variables["batch_stats"] = state.batch_stats
+        logits, mutated = state.apply_fn(
+            variables, img, train=True, mutable=["batch_stats"])
+        loss = cls.sparse_distill_kl(logits, batch["logits.idx"],
+                                     batch["logits.val"])
+        return loss, {"batch_stats": mutated["batch_stats"]}
+
+    step = make_train_step(distill_loss, donate=False)
+
+    def batches():
+        epoch = 0
+        while True:
+            yield from loader.epoch(epoch)
+            epoch += 1
+
+    dreader = DistillReader(batches, feeds=("image",), predicts=("logits",),
+                            teachers=endpoints,
+                            teacher_batch_size=teacher_bs,
+                            rpc_timeout=60.0, pipeline_depth=4,
+                            manage_interval=0.2, compress_topk=serve_topk,
+                            sparse_predicts=True)
+    wire_keys = ("image", "logits.idx", "logits.val")
+    it = dreader()
+    total = steady_steps + churn_steps + rejoin_steps
+    stamps = []   # perf_counter after each SYNCED step
+    t_kill = t_rejoin = None
+    try:
+        # warmup/compile outside the timeline
+        b = {k: v for k, v in next(it).items() if k in wire_keys}
+        state, metrics = step(state, mesh_lib.shard_batch(mesh, b))
+        _sync(metrics["loss"])
+        stamps.append(time.perf_counter())
+        for i in range(total):
+            if i == steady_steps:
+                server_a.stop()          # teacher killed mid-run
+                t_kill = time.perf_counter()
+            if i == steady_steps + churn_steps:
+                server_a = new_server(port_a)   # re-added, same endpoint
+                t_rejoin = time.perf_counter()
+            b = {k: v for k, v in next(it).items() if k in wire_keys}
+            state, metrics = step(state, mesh_lib.shard_batch(mesh, b))
+            _sync(metrics["loss"])
+            stamps.append(time.perf_counter())
+    finally:
+        it.close()
+        dreader.close()
+        server_a.stop()
+        server_b.stop()
+
+    rates = [batch_size / (b - a) for a, b in zip(stamps, stamps[1:])]
+    steady = float(np.median(rates[:steady_steps]))
+    dip = float(min(rates[steady_steps:]))
+    # recovery: first post-kill step whose rate is back within 80% of
+    # steady; its timestamp minus the kill instant
+    recovery_s = None
+    for i in range(steady_steps, total):
+        if rates[i] >= 0.8 * steady:
+            recovery_s = stamps[i + 1] - t_kill
+            break
+    return {"steady_imgs_per_sec": round(steady, 1),
+            "dip_imgs_per_sec": round(dip, 1),
+            "recovery_s": round(recovery_s, 2)
+            if recovery_s is not None else None,
+            "kill_to_rejoin_s": round(t_rejoin - t_kill, 2),
+            "post_rejoin_imgs_per_sec": round(
+                float(np.median(rates[steady_steps + churn_steps:])), 1)}
 
 
 def distill_quality_extras() -> dict:
@@ -649,7 +878,9 @@ def main() -> None:
     loader = bench_input_plane(on_tpu)
     transformer = bench_transformer(on_tpu)
     flash = bench_flash_kernel(on_tpu)
+    hybrid = bench_hybrid_mesh(on_tpu)
     distill = bench_distill(on_tpu)
+    churn = bench_distill_churn(on_tpu)
     cores_to_feed = (resnet["imgs_per_sec"]
                      / max(loader["imgs_per_sec_per_core"], 1e-9))
     print(json.dumps({
@@ -679,7 +910,18 @@ def main() -> None:
             "transformer_mfu_large": transformer["mfu_large"],
             "flash_attn_speedup": flash["speedup_vs_dense"],
             "flash_attn_seq_len": flash["seq_len"],
+            # hybrid ICI x DCN mesh vs flat on the same devices: the
+            # comms win on real multi-slice, parity (~1.0) on
+            # single-link worlds (CPU / one chip)
+            "hybrid_mesh_flat_step_ms": hybrid["flat_step_ms"],
+            "hybrid_mesh_step_ms": hybrid["hybrid_step_ms"],
+            "hybrid_vs_flat_step_ratio":
+                hybrid["hybrid_vs_flat_step_ratio"],
+            "hybrid_mesh_n_slices": hybrid["n_slices"],
+            # distill wire numbers are MEDIAN OF 3 with [min, max]
             "distill_student_imgs_per_sec": distill["imgs_per_sec"],
+            "distill_student_imgs_per_sec_spread":
+                distill["imgs_per_sec_spread"],
             "distill_vs_colocated_baseline":
                 distill["vs_colocated_baseline"],
             # bounds for the disaggregated headline (BASELINE.md math):
@@ -687,7 +929,11 @@ def main() -> None:
             # per-chip serving capacity under concurrent clients
             "distill_student_ceiling_imgs_per_sec":
                 distill["student_ceiling_imgs_per_sec"],
+            "distill_student_ceiling_spread":
+                distill["student_ceiling_spread"],
             "teacher_imgs_per_sec": distill["teacher_imgs_per_sec"],
+            "teacher_imgs_per_sec_spread":
+                distill["teacher_imgs_per_sec_spread"],
             "teacher_chip_imgs_per_sec":
                 distill["teacher_chip_imgs_per_sec"],
             "teacher_coalesce_batch_rows_mean":
@@ -708,6 +954,15 @@ def main() -> None:
                 distill["wire_logits_bytes_dense"],
             "distill_wire_logits_bytes": distill["wire_logits_bytes"],
             "distill_serve_topk": distill["serve_topk"],
+            # distill under teacher churn: kill + re-add mid-run
+            # (VERDICT r5 ask #6)
+            "distill_churn_steady_imgs_per_sec":
+                churn["steady_imgs_per_sec"],
+            "distill_churn_dip_imgs_per_sec": churn["dip_imgs_per_sec"],
+            "distill_churn_recovery_s": churn["recovery_s"],
+            "distill_churn_kill_to_rejoin_s": churn["kill_to_rejoin_s"],
+            "distill_churn_post_rejoin_imgs_per_sec":
+                churn["post_rejoin_imgs_per_sec"],
             # flagship distill QUALITY (committed artifact; see
             # tools/distill_quality_tpu.py)
             **distill_quality_extras(),

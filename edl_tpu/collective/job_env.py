@@ -25,6 +25,13 @@ class JobEnv:
                                  env="EDL_TPU_STORE_ENDPOINTS")
     nodes_range: str = field("1:16", env="EDL_TPU_NODES_RANGE")  # "min:max"
     nproc_per_node: int = field(0, env="EDL_TPU_NPROC_PERNODE")  # 0 = auto
+    # Multi-slice (hybrid ICI x DCN) topology: how many TPU slices the
+    # job spans. 0 = auto (trainers detect from jax.devices()
+    # slice_index; flat single-slice world when the hardware reports
+    # none). >1 partitions the pods rank-contiguously into slices and
+    # the trainers build hybrid meshes with dp crossing DCN
+    # (parallel/mesh.make_hybrid_mesh).
+    slices: int = field(0, env="EDL_TPU_SLICES")
     up_limit_nodes: int = field(1024, env="EDL_TPU_UP_LIMIT_NODES")
     checkpoint_path: str = field("", env="EDL_TPU_CHECKPOINT_PATH")
     job_server: str = field("", env="EDL_TPU_JOBSERVER")
@@ -59,7 +66,8 @@ TRAINER_ENV_VARS = ("EDL_TPU_RANK", "EDL_TPU_WORLD_SIZE",
                     "EDL_TPU_COORDINATOR", "EDL_TPU_CLUSTER_JSON",
                     "EDL_TPU_JOB_ID", "EDL_TPU_POD_ID",
                     "EDL_TPU_CHECKPOINT_PATH", "EDL_TPU_STORE_ENDPOINTS",
-                    "EDL_TPU_CLUSTER_VERSION")
+                    "EDL_TPU_CLUSTER_VERSION", "EDL_TPU_SLICES",
+                    "EDL_TPU_SLICE_ID")
 
 
 @dataclass
@@ -76,6 +84,11 @@ class TrainerEnv:
     checkpoint_path: str = field("", env="EDL_TPU_CHECKPOINT_PATH")
     store_endpoints: str = field("", env="EDL_TPU_STORE_ENDPOINTS")
     cluster_version: int = field(0, env="EDL_TPU_CLUSTER_VERSION")
+    # slice topology (hybrid ICI x DCN meshes): 0/-1 = auto-detect from
+    # the devices; set by the launcher when the operator pins
+    # EDL_TPU_SLICES on the job
+    n_slices: int = field(0, env="EDL_TPU_SLICES")
+    slice_id: int = field(-1, env="EDL_TPU_SLICE_ID")
 
     @classmethod
     def from_environ(cls, **overrides) -> "TrainerEnv":
@@ -91,11 +104,35 @@ class TrainerEnv:
         return self.rank == 0
 
 
+def slice_of_rank(rank: int, world_size: int, n_slices: int) -> int:
+    """Rank-contiguous slice assignment: ranks [0, w/s) -> slice 0, etc.
+
+    Contiguity matters: the barrier orders pods by claimed rank, and GKE
+    multi-slice JobSets hand out completion indices slice-by-slice, so
+    contiguous rank blocks are the physical slices. When each POD spans
+    multiple slices (n_slices a multiple of world_size — one launcher
+    driving all local devices, the CPU-emulation shape) no single slice
+    id applies: return -1 (auto) and let the trainer's slice_topology
+    split its local devices. Anything else is a misconfiguration the
+    hybrid mesh would reject anyway — fail here with the better message.
+    """
+    if n_slices <= 1:
+        return 0
+    if world_size % n_slices == 0:
+        return rank // (world_size // n_slices)
+    if n_slices % world_size == 0:
+        return -1  # pod-local multi-slice: id is per-device, not per-pod
+    raise ValueError(
+        f"world_size={world_size} not divisible by "
+        f"EDL_TPU_SLICES={n_slices} (nor vice versa)")
+
+
 def trainer_environ(cluster: Cluster, pod_id: str, job: JobEnv) -> dict:
     """Env block for the trainer subprocess (reference edl_process.py:51-59)."""
     env = dict(os.environ)
+    rank = cluster.rank_of(pod_id)
     env.update({
-        "EDL_TPU_RANK": str(cluster.rank_of(pod_id)),
+        "EDL_TPU_RANK": str(rank),
         "EDL_TPU_WORLD_SIZE": str(cluster.world_size),
         "EDL_TPU_COORDINATOR": cluster.coordinator,
         "EDL_TPU_CLUSTER_JSON": cluster.to_json(),
@@ -104,6 +141,10 @@ def trainer_environ(cluster: Cluster, pod_id: str, job: JobEnv) -> dict:
         "EDL_TPU_CHECKPOINT_PATH": job.checkpoint_path,
         "EDL_TPU_STORE_ENDPOINTS": job.store_endpoints,
         "EDL_TPU_CLUSTER_VERSION": str(cluster.version),
+        "EDL_TPU_SLICES": str(job.slices),
+        "EDL_TPU_SLICE_ID": str(
+            slice_of_rank(rank, cluster.world_size, job.slices)
+            if job.slices > 1 else -1),
     })
     return env
 

@@ -173,6 +173,11 @@ def parse_args(argv=None) -> tuple[JobEnv, list[str]]:
                         help="coordination store endpoint host:port")
     parser.add_argument("--nodes-range", default=None, help="min:max")
     parser.add_argument("--nproc-per-node", type=int, default=None)
+    parser.add_argument("--slices", dest="slices", type=int, default=None,
+                        help="TPU slice count for hybrid ICIxDCN meshes "
+                             "(0 = auto-detect from the hardware; >1 "
+                             "partitions pods rank-contiguously and "
+                             "trainers place dp across DCN)")
     parser.add_argument("--checkpoint-path", default=None)
     parser.add_argument("--log-dir", default=None)
     parser.add_argument("cmd", nargs=argparse.REMAINDER,

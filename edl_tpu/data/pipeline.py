@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import jax
@@ -338,8 +339,9 @@ def prefetch(it: Iterable, size: int = 2,
         # Worker starts lazily on first next(): a generator closed (or
         # GC'd) before it ever runs skips the body entirely — including
         # finally — so an eager thread could never be stopped.
-        threading.Thread(target=worker, daemon=True,
-                         name="data-prefetch").start()
+        thread = threading.Thread(target=worker, daemon=True,
+                                  name="data-prefetch")
+        thread.start()
         try:
             while True:
                 item = q.get()
@@ -350,11 +352,21 @@ def prefetch(it: Iterable, size: int = 2,
                 yield item
         finally:
             stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
+            # Drain AND join: the worker may be mid-next(it) on the
+            # upstream iterator; returning before it exits would let the
+            # caller close that iterator while it is still executing
+            # ("generator already executing"). Keep draining while we
+            # wait — the worker may still be trying to put one item/_END.
+            deadline = time.monotonic() + 30.0
+            while True:
+                try:
+                    while True:
+                        q.get_nowait()
+                except queue.Empty:
+                    pass
+                thread.join(timeout=0.1)
+                if not thread.is_alive() or time.monotonic() > deadline:
+                    break
 
     return gen()
 

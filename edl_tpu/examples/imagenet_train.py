@@ -253,7 +253,10 @@ def main(argv=None) -> int:
                          f"world {world}")
     local_bs = args.batch_size // world
 
-    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": -1}))
+    # hybrid ICI x DCN when the job is (or declares itself) multi-slice:
+    # dp's major dimension crosses DCN, flat dp otherwise
+    mesh = distributed.make_mesh_from_env(mesh_lib.MeshSpec({"dp": -1}),
+                                          env)
     data_sharding = mesh_lib.data_sharding(mesh)
     normalize = None
     if args.data_format == "jpeg":

@@ -150,7 +150,11 @@ def main(argv=None) -> int:
             raise SystemExit(f"--mesh sp shards the sequence over "
                              f"{n_dev} devices; --seq-len {args.seq_len} "
                              f"is not divisible by {n_dev}")
-    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({kind: -1}))
+    # env-aware: multi-slice jobs get the hybrid ICI x DCN layout (needs
+    # a dp axis to carry DCN — other --mesh kinds fail fast there);
+    # single-slice worlds get the flat mesh as before
+    mesh = distributed.make_mesh_from_env(mesh_lib.MeshSpec({kind: -1}),
+                                          env)
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,

@@ -71,9 +71,14 @@ def main(argv=None) -> int:
         raise SystemExit(f"global batch {args.global_batch} not divisible "
                          f"by world size {world}")
     local_bs = args.global_batch // world
-    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": -1}))
-    log.info("trainer up: rank=%d world=%d devices=%d cluster_v=%d",
-             env.rank, world, jax.device_count(), env.cluster_version)
+    # Hybrid ICI x DCN mesh when the job is (or declares itself)
+    # multi-slice — dp's major dimension crosses DCN; flat dp otherwise.
+    mesh = distributed.make_mesh_from_env(mesh_lib.MeshSpec({"dp": -1}),
+                                          env)
+    topo = distributed.slice_topology(env)
+    log.info("trainer up: rank=%d world=%d devices=%d cluster_v=%d "
+             "slices=%dx%d", env.rank, world, jax.device_count(),
+             env.cluster_version, topo.n_slices, topo.chips_per_slice)
 
     model = LinearRegression(features=1)
     tx = optax.sgd(0.05)

@@ -1,5 +1,8 @@
 from edl_tpu.parallel.mesh import (
     MeshSpec,
+    SliceTopology,
+    detect_slice_topology,
+    make_hybrid_mesh,
     make_mesh,
     data_sharding,
     form_global_batch,
@@ -7,7 +10,11 @@ from edl_tpu.parallel.mesh import (
     replicated,
     shard_batch,
 )
-from edl_tpu.parallel.distributed import init_from_env
+from edl_tpu.parallel.distributed import (
+    init_from_env,
+    make_mesh_from_env,
+    slice_topology,
+)
 from edl_tpu.parallel.sharding import (
     DEFAULT_RULES,
     constrain,
@@ -19,7 +26,12 @@ from edl_tpu.parallel import ring_attention  # module (fn: ring_attention.ring_a
 
 __all__ = [
     "MeshSpec",
+    "SliceTopology",
+    "detect_slice_topology",
+    "make_hybrid_mesh",
     "make_mesh",
+    "make_mesh_from_env",
+    "slice_topology",
     "data_sharding",
     "form_global_batch",
     "init_from_env",

@@ -55,7 +55,18 @@ def force_platform_from_env() -> None:
         if plat:
             jax.config.update("jax_platforms", plat)
         if ndev_i is not None:
-            jax.config.update("jax_num_cpu_devices", ndev_i)
+            try:
+                jax.config.update("jax_num_cpu_devices", ndev_i)
+            except AttributeError:
+                # jax < 0.5: no such option; the XLA flag is the portable
+                # spelling, read at backend init (same fallback as
+                # tests/conftest.py)
+                if "xla_force_host_platform_device_count" not in \
+                        os.environ.get("XLA_FLAGS", ""):
+                    os.environ["XLA_FLAGS"] = (
+                        os.environ.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count="
+                          f"{ndev_i}").strip()
     except RuntimeError:  # backend already up — leave it be
         pass
 
@@ -87,6 +98,47 @@ def init_from_env(env: TrainerEnv | None = None) -> TrainerEnv:
             process_id=env.rank)
         _initialized = True
     return env
+
+
+def slice_topology(env: TrainerEnv | None = None,
+                   devices: list | None = None):
+    """Derive the job's ICI×DCN SliceTopology.
+
+    Priority: the env contract (EDL_TPU_SLICES > 1 — the operator pinned
+    the slice count on the job, e.g. a GKE multi-slice JobSet) beats
+    hardware auto-detect (`jax.devices()` slice_index, present on TPU
+    multi-slice), which beats the flat single-slice default. The env
+    path lets CPU worlds and single-slice dev boxes EMULATE multi-slice
+    for tests/dryruns; the detect path needs no configuration at all.
+    """
+    from edl_tpu.parallel.mesh import SliceTopology, detect_slice_topology
+
+    env = env or TrainerEnv.from_environ()
+    if devices is None:
+        devices = jax.devices()
+    if env.n_slices > 1:
+        if len(devices) % env.n_slices != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by "
+                f"EDL_TPU_SLICES={env.n_slices}")
+        return SliceTopology(env.n_slices, len(devices) // env.n_slices)
+    detected = detect_slice_topology(devices)
+    return detected if detected.is_multi_slice else SliceTopology(
+        1, len(devices))
+
+
+def make_mesh_from_env(spec=None, env: TrainerEnv | None = None,
+                       devices: list | None = None):
+    """The mesh a launched trainer should train on: hybrid ICI×DCN when
+    the world is (or is declared) multi-slice, flat otherwise. Elastic
+    resizes re-form correctly because MeshSpec resolves against
+    (n_slices, chips_per_slice), not a flat device count."""
+    from edl_tpu.parallel import mesh as mesh_lib
+
+    topo = slice_topology(env, devices)
+    if topo.is_multi_slice:
+        return mesh_lib.make_hybrid_mesh(spec, topo, devices=devices)
+    return mesh_lib.make_mesh(spec, devices=devices)
 
 
 def is_initialized() -> bool:

@@ -16,6 +16,16 @@ Axes (any may be size 1):
 Elasticity: a mesh is a pure function of the device list, so an elastic
 resize is just `make_mesh(spec, n_devices=new_n)` after restart — checkpoint
 state re-placed onto the new mesh by the sharding rules.
+
+Multi-slice (hybrid ICI×DCN) topology: a multi-pod TPU job spans SLICES
+joined by data-center network, with fast ICI only within a slice. The
+capability analogue of the reference's hierarchical allreduce
+(train_with_fleet.py:93 `use_hierarchical_allreduce`): `make_hybrid_mesh`
+places the dp axis's MAJOR component across slices (the only axis whose
+collectives cross DCN — one gradient allreduce per step, bandwidth-bound
+and latency-tolerant) while fsdp/tp/sp — the chatty per-layer collectives
+— stay entirely inside a slice on ICI. XLA's SPMD partitioner then emits
+the two-level reduction from the device order alone.
 """
 
 from __future__ import annotations
@@ -25,6 +35,56 @@ from dataclasses import dataclass, field
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# The axis whose collectives are allowed to cross the slow DCN boundary.
+DCN_AXIS = "dp"
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """Two-level device topology: n_slices pods of chips_per_slice chips,
+    DCN between slices, ICI within. (1, n) is the flat single-slice
+    world every other constructor assumes."""
+
+    n_slices: int = 1
+    chips_per_slice: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.n_slices * self.chips_per_slice
+
+    @property
+    def is_multi_slice(self) -> bool:
+        return self.n_slices > 1
+
+
+def slice_groups(devices: list) -> list[list]:
+    """Group devices by their hardware slice.
+
+    Uses `device.slice_index` when the platform reports it (TPU
+    multi-slice); devices without one (CPU test worlds, single-slice
+    TPUs) all land in one group — callers emulating multi-slice on flat
+    hardware pass an explicit SliceTopology instead.
+    """
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", None) or 0, []).append(d)
+    return [groups[k] for k in sorted(groups)]
+
+
+def detect_slice_topology(devices: list | None = None) -> SliceTopology:
+    """SliceTopology reported by the hardware (flat world if it reports
+    nothing). Raises on ragged slices — a hybrid mesh needs equal
+    chips_per_slice."""
+    if devices is None:
+        devices = jax.devices()
+    groups = slice_groups(devices)
+    sizes = {len(g) for g in groups}
+    if len(sizes) > 1:
+        raise ValueError(
+            f"ragged slices (chips per slice: {sorted(len(g) for g in groups)}"
+            f") — cannot form a hybrid mesh")
+    return SliceTopology(len(groups), len(devices) // len(groups))
 
 
 @dataclass(frozen=True)
@@ -48,6 +108,127 @@ class MeshSpec:
         if total != n_devices:
             raise ValueError(f"mesh {sizes} != {n_devices} devices")
         return sizes
+
+    def resolve_hybrid(self, topology: SliceTopology
+                       ) -> tuple[dict[str, int], dict[str, int]]:
+        """Split each axis size into (dcn, ici) factors against
+        (n_slices, chips_per_slice) instead of a flat device count.
+
+        Placement contract: only `dp` crosses DCN — its dcn factor is
+        n_slices; every other axis (and dp's remaining factor) lives
+        inside a slice. An elastic resize that changes EITHER level
+        re-resolves cleanly: the per-slice axes never see the slice
+        count, so adding a slice scales dp without re-factoring
+        fsdp/tp/sp.
+        """
+        n_slices, per_slice = topology.n_slices, topology.chips_per_slice
+        sizes = dict(self.axes)
+        if n_slices > 1 and DCN_AXIS not in sizes:
+            raise ValueError(
+                f"multi-slice mesh needs a {DCN_AXIS!r} axis to carry the "
+                f"DCN dimension; got axes {list(sizes)}")
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError("at most one axis may be -1")
+        # dp's in-slice factor: explicit sizes must carry the n_slices
+        # multiple; a wildcard dp absorbs what the slice leaves over.
+        dp_total = sizes.get(DCN_AXIS, 1)
+        if dp_total != -1 and dp_total % n_slices != 0:
+            raise ValueError(
+                f"{DCN_AXIS}={dp_total} not divisible by n_slices="
+                f"{n_slices} (dp's major component spans the slices)")
+        ici_fixed = int(np.prod(
+            [v for k, v in sizes.items() if v != -1 and k != DCN_AXIS]))
+        if dp_total != -1:
+            ici_fixed *= dp_total // n_slices
+        if wild:
+            if per_slice % ici_fixed != 0:
+                raise ValueError(
+                    f"chips_per_slice={per_slice} not divisible by fixed "
+                    f"in-slice axes of {sizes}")
+            if wild[0] == DCN_AXIS:
+                sizes[DCN_AXIS] = n_slices * (per_slice // ici_fixed)
+            else:
+                sizes[wild[0]] = per_slice // ici_fixed
+        dcn = {k: (n_slices if k == DCN_AXIS else 1) for k in sizes}
+        ici = {k: (v // n_slices if k == DCN_AXIS else v)
+               for k, v in sizes.items()}
+        if int(np.prod(list(ici.values()))) != per_slice:
+            raise ValueError(
+                f"mesh {sizes} != {n_slices} slices x {per_slice} chips")
+        return dcn, ici
+
+
+def make_mesh(spec: MeshSpec | None = None, n_devices: int | None = None,
+              devices: list | None = None) -> Mesh:
+    """Build a Mesh over the first n_devices (elastic prefix of the world)."""
+    spec = spec or MeshSpec()
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"want {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    sizes = spec.resolve(len(devices))
+    arr = np.array(devices).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def make_hybrid_mesh(spec: MeshSpec | None = None,
+                     topology: SliceTopology | None = None,
+                     devices: list | None = None,
+                     n_devices: int | None = None) -> Mesh:
+    """Build a two-level ICI×DCN Mesh: dp's major dimension enumerates
+    slices (DCN hops), everything else stays slice-local (ICI).
+
+    Same shape contract as jax's `mesh_utils.create_hybrid_device_mesh`
+    (global axis = dcn_factor * ici_factor, dcn major) without requiring
+    the hardware to report a slice_index: `topology` may be passed
+    explicitly to EMULATE a multi-slice layout on a flat device world
+    (CPU tests, the dryrun), in which case slices are contiguous device
+    chunks. With topology=None the hardware's slice_index decides —
+    degenerating to a flat `make_mesh` on single-slice worlds.
+    """
+    spec = spec or MeshSpec()
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"want {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    if topology is None:
+        topology = detect_slice_topology(devices)
+    if topology.n_devices != len(devices):
+        raise ValueError(
+            f"topology {topology.n_slices}x{topology.chips_per_slice} != "
+            f"{len(devices)} devices")
+    if not topology.is_multi_slice:
+        return make_mesh(spec, devices=devices)
+    groups = slice_groups(devices)
+    if len(groups) == topology.n_slices:
+        ordered = [d for g in groups for d in g]
+    elif len(groups) == 1:
+        # flat hardware, emulated slices: contiguous chunks
+        ordered = list(devices)
+    else:
+        raise ValueError(
+            f"hardware reports {len(groups)} slices but topology asks for "
+            f"{topology.n_slices}")
+    dcn, ici = spec.resolve_hybrid(topology)
+    names = list(spec.axes.keys())
+    # (slice-major, chip-minor) grid -> (d0..dk, i0..ik) -> interleave so
+    # each named axis is dcn-major x ici-minor -> merge the pairs. The
+    # resulting device order makes dp's stride-per-slice the LARGEST, so
+    # only dp collectives cross the slice boundary.
+    grid = np.array(ordered, dtype=object).reshape(
+        tuple(dcn[n] for n in names) + tuple(ici[n] for n in names))
+    k = len(names)
+    grid = grid.transpose(
+        [x for pair in zip(range(k), range(k, 2 * k)) for x in pair])
+    arr = grid.reshape(tuple(dcn[n] * ici[n] for n in names))
+    return Mesh(arr, tuple(names))
 
 
 def make_mesh(spec: MeshSpec | None = None, n_devices: int | None = None,

@@ -1,0 +1,257 @@
+"""Hybrid ICI x DCN multi-slice meshes on the 8-device CPU world.
+
+The CPU world has no hardware slice_index, so every multi-slice test
+passes an explicit SliceTopology — the same emulation path the dryrun
+uses. The invariants under test are topology-independent: dp's major
+dimension enumerates slices, every other axis stays slice-local, and a
+hybrid mesh is a pure device PERMUTATION of the flat mesh, so training
+math is identical to numerical tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu.collective.cluster import Pod, form_cluster
+from edl_tpu.collective.job_env import JobEnv, TrainerEnv, trainer_environ
+from edl_tpu.parallel.distributed import make_mesh_from_env, slice_topology
+from edl_tpu.parallel.mesh import (
+    MeshSpec, SliceTopology, detect_slice_topology, dp_size,
+    form_global_batch, make_hybrid_mesh, make_mesh, shard_batch)
+
+
+def _slice_of(device, chips_per_slice):
+    """Emulated slice id: contiguous chunks of the flat device list."""
+    return jax.devices().index(device) // chips_per_slice
+
+
+# -- resolution against (n_slices, chips_per_slice) -------------------------
+
+def test_resolve_hybrid_wildcard_dp_absorbs_both_levels():
+    dcn, ici = MeshSpec({"dp": -1, "tp": 2}).resolve_hybrid(
+        SliceTopology(2, 4))
+    assert dcn == {"dp": 2, "tp": 1}
+    assert ici == {"dp": 2, "tp": 2}
+
+
+def test_resolve_hybrid_fixed_dp_splits_on_slices():
+    dcn, ici = MeshSpec({"dp": 8}).resolve_hybrid(SliceTopology(2, 4))
+    assert (dcn["dp"], ici["dp"]) == (2, 4)
+
+
+def test_resolve_hybrid_wildcard_nondp():
+    dcn, ici = MeshSpec({"dp": 2, "fsdp": -1}).resolve_hybrid(
+        SliceTopology(2, 4))
+    assert dcn == {"dp": 2, "fsdp": 1}
+    assert ici == {"dp": 1, "fsdp": 4}
+
+
+def test_resolve_hybrid_rejects_bad_shapes():
+    topo = SliceTopology(2, 4)
+    with pytest.raises(ValueError):  # no dp axis to carry DCN
+        MeshSpec({"fsdp": 8}).resolve_hybrid(topo)
+    with pytest.raises(ValueError):  # dp not divisible by n_slices
+        MeshSpec({"dp": 3, "tp": -1}).resolve_hybrid(topo)
+    with pytest.raises(ValueError):  # tp does not fit in a slice
+        MeshSpec({"dp": -1, "tp": 3}).resolve_hybrid(topo)
+    with pytest.raises(ValueError):
+        MeshSpec({"dp": -1, "tp": -1}).resolve_hybrid(topo)
+
+
+def test_single_slice_degenerates_to_flat():
+    flat = make_mesh(MeshSpec({"dp": -1, "tp": 2}))
+    hyb = make_hybrid_mesh(MeshSpec({"dp": -1, "tp": 2}),
+                           SliceTopology(1, 8))
+    assert hyb.shape == flat.shape
+    assert [d.id for d in hyb.devices.flat] == \
+        [d.id for d in flat.devices.flat]
+
+
+# -- device placement: dp crosses DCN, the rest stays slice-local -----------
+
+def test_dp_major_enumerates_slices_and_others_stay_local():
+    topo = SliceTopology(2, 4)
+    mesh = make_hybrid_mesh(MeshSpec({"dp": -1, "tp": 2}), topo)
+    devs = mesh.devices  # (dp=4, tp=2)
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    # dp's major half is entirely slice 0, minor half slice 1
+    assert {_slice_of(d, 4) for d in devs[:2].flat} == {0}
+    assert {_slice_of(d, 4) for d in devs[2:].flat} == {1}
+    # every tp line lives inside ONE slice (no per-layer DCN traffic)
+    for row in devs:
+        assert len({_slice_of(d, 4) for d in row}) == 1
+
+
+def test_topology_must_match_device_count():
+    with pytest.raises(ValueError):
+        make_hybrid_mesh(MeshSpec({"dp": -1}), SliceTopology(2, 3))
+
+
+def test_detect_slice_topology_flat_on_cpu():
+    topo = detect_slice_topology(jax.devices())
+    assert topo == SliceTopology(1, 8)
+    assert not topo.is_multi_slice
+
+
+# -- elasticity: the hybrid mesh re-forms across resizes --------------------
+
+def test_hybrid_mesh_reforms_across_resizes():
+    """2 -> 4 -> 8 devices, always 2 slices: per-slice axes re-resolve
+    against chips_per_slice, dp absorbs the growth, batches place."""
+    spec = MeshSpec({"dp": -1, "fsdp": 2})
+    for n in (4, 8):  # fsdp=2 needs >=2 chips per slice
+        topo = SliceTopology(2, n // 2)
+        mesh = make_hybrid_mesh(spec, topo, n_devices=n)
+        assert mesh.shape["fsdp"] == 2
+        assert mesh.shape["dp"] == n // 2
+        assert dp_size(mesh) == n
+        batch = shard_batch(mesh, {"x": np.arange(2 * n * 3, dtype=np.float32)
+                                   .reshape(2 * n, 3)})
+        assert batch["x"].addressable_shards[0].data.shape == (2, 3)
+    # the 2-device world: one chip per slice, dp-only
+    mesh = make_hybrid_mesh(MeshSpec({"dp": -1}), SliceTopology(2, 1),
+                            n_devices=2)
+    assert mesh.shape == {"dp": 2}
+    assert dp_size(mesh) == 2
+
+
+def test_shard_batch_rows_follow_dp_device_order():
+    """When dp spans the slice axis, row blocks land slice-major: the
+    first half of the batch on slice 0, second half on slice 1 — the
+    layout form_global_batch's per-process contiguous-slice contract
+    relies on in a real multi-slice world."""
+    topo = SliceTopology(2, 4)
+    mesh = make_hybrid_mesh(MeshSpec({"dp": -1}), topo)
+    x = np.arange(16 * 2, dtype=np.float32).reshape(16, 2)
+    placed = shard_batch(mesh, {"x": x})["x"]
+    np.testing.assert_array_equal(np.asarray(placed), x)  # round trip
+    for shard in placed.addressable_shards:
+        rows = shard.data[:, 0] / 2  # row ids (x[i, 0] = 2i)
+        lo = rows.min()
+        # rows 0-7 (batch half 0) must sit on slice-0 devices
+        assert _slice_of(shard.device, 4) == (0 if lo < 8 else 1)
+
+
+def test_form_global_batch_on_hybrid_mesh():
+    """Single-process world: degenerates to shard_batch but must honor
+    the hybrid data sharding (dp spanning slices)."""
+    topo = SliceTopology(2, 4)
+    mesh = make_hybrid_mesh(MeshSpec({"dp": -1, "fsdp": 2}), topo)
+    local = {"x": np.arange(8 * 2, dtype=np.float32).reshape(8, 2)}
+    placed = form_global_batch(mesh, local)
+    np.testing.assert_array_equal(np.asarray(placed["x"]), local["x"])
+    assert placed["x"].addressable_shards[0].data.shape == (1, 2)
+
+
+# -- the tentpole invariant: hybrid == flat to numerical tolerance ----------
+
+def test_hybrid_mesh_loss_matches_flat_mesh():
+    """Same params, same data, one dp-allreduced gradient step on the
+    flat {dp:8} mesh vs the 2-slice hybrid — the hybrid mesh is a device
+    permutation; loss and updated params must agree."""
+    rng = np.random.default_rng(0)
+    w0 = jnp.asarray(rng.normal(size=(3, 5)).astype(np.float32))
+    x = np.asarray(rng.normal(size=(16, 3)).astype(np.float32))
+    y = np.asarray(rng.normal(size=(16, 5)).astype(np.float32))
+
+    def run(mesh):
+        @jax.jit
+        def step(w, batch):
+            def loss_fn(w):
+                return jnp.mean((batch["x"] @ w - batch["y"]) ** 2)
+            loss, g = jax.value_and_grad(loss_fn)(w)
+            return w - 0.1 * g, loss
+
+        batch = shard_batch(mesh, {"x": x, "y": y})
+        w = jax.device_put(w0, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()))
+        for _ in range(3):
+            w, loss = step(w, batch)
+        return np.asarray(w), float(loss)
+
+    flat_w, flat_loss = run(make_mesh(MeshSpec({"dp": -1})))
+    hyb_w, hyb_loss = run(make_hybrid_mesh(MeshSpec({"dp": -1}),
+                                           SliceTopology(2, 4)))
+    assert np.isclose(hyb_loss, flat_loss, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(hyb_w, flat_w, rtol=1e-5, atol=1e-6)
+
+
+def test_hybrid_mesh_loss_matches_flat_mesh_with_fsdp():
+    """Same invariant with a 2D dp x fsdp data world (both axes carry
+    batch rows; fsdp is slice-local in the hybrid layout)."""
+    rng = np.random.default_rng(1)
+    w0 = jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32))
+    x = np.asarray(rng.normal(size=(16, 4)).astype(np.float32))
+
+    def run(mesh):
+        @jax.jit
+        def loss(w, batch):
+            return jnp.mean(jnp.tanh(batch["x"] @ w) ** 2)
+
+        batch = shard_batch(mesh, {"x": x})
+        w = jax.device_put(w0, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()))
+        return float(loss(w, batch))
+
+    spec = MeshSpec({"dp": -1, "fsdp": 2})
+    flat = run(make_mesh(spec))
+    hyb = run(make_hybrid_mesh(spec, SliceTopology(2, 4)))
+    assert np.isclose(hyb, flat, rtol=1e-5, atol=1e-7)
+
+
+# -- env contract -----------------------------------------------------------
+
+def test_slice_topology_env_beats_detection():
+    topo = slice_topology(TrainerEnv(n_slices=2))
+    assert topo == SliceTopology(2, 4)
+    assert slice_topology(TrainerEnv()) == SliceTopology(1, 8)
+    with pytest.raises(ValueError):
+        slice_topology(TrainerEnv(n_slices=3))  # 8 % 3 != 0
+
+
+def test_make_mesh_from_env_hybrid_vs_flat():
+    spec = MeshSpec({"dp": -1, "fsdp": 2})
+    hyb = make_mesh_from_env(spec, TrainerEnv(n_slices=2))
+    assert hyb.shape == {"dp": 4, "fsdp": 2}
+    # dp-major half on slice 0 => it IS the hybrid layout
+    assert {_slice_of(d, 4) for d in hyb.devices[:2].flat} == {0}
+    flat = make_mesh_from_env(spec, TrainerEnv())
+    assert [d.id for d in flat.devices.flat] == list(range(8))
+
+
+def test_trainer_environ_carries_slice_contract():
+    pods = [Pod(pod_id=f"p{i}", addr="127.0.0.1", port=7000 + i,
+                claimed_rank=i) for i in range(4)]
+    cluster = form_cluster("job", 1, pods)
+    job = JobEnv.from_environ(job_id="job", pod_id="p2", slices=2)
+    env = trainer_environ(cluster, "p2", job)
+    assert env["EDL_TPU_SLICES"] == "2"
+    assert env["EDL_TPU_SLICE_ID"] == "1"  # ranks 2,3 -> slice 1
+    # rank-contiguous: first half of the ranks is slice 0
+    assert trainer_environ(cluster, "p0", job)["EDL_TPU_SLICE_ID"] == "0"
+    assert trainer_environ(cluster, "p1", job)["EDL_TPU_SLICE_ID"] == "0"
+    # flat jobs keep the auto markers
+    flat = trainer_environ(cluster, "p0",
+                           JobEnv.from_environ(job_id="job", pod_id="p0"))
+    assert flat["EDL_TPU_SLICES"] == "0"
+    assert flat["EDL_TPU_SLICE_ID"] == "-1"
+    # one pod spanning both slices locally (emulation / single-host):
+    # slice id is per-device, not per-pod -> auto marker
+    solo = form_cluster("job", 1, [Pod(pod_id="p0", addr="127.0.0.1",
+                                       port=7000, claimed_rank=0)])
+    env1 = trainer_environ(solo, "p0",
+                           JobEnv.from_environ(job_id="job", pod_id="p0",
+                                               slices=2))
+    assert env1["EDL_TPU_SLICES"] == "2"
+    assert env1["EDL_TPU_SLICE_ID"] == "-1"
+    from edl_tpu.collective.job_env import slice_of_rank
+    with pytest.raises(ValueError):
+        slice_of_rank(0, 3, 2)  # 3 pods, 2 slices: neither divides
+
+
+def test_trainer_env_parses_slice_vars(monkeypatch):
+    monkeypatch.setenv("EDL_TPU_SLICES", "2")
+    monkeypatch.setenv("EDL_TPU_SLICE_ID", "1")
+    env = TrainerEnv.from_environ()
+    assert env.n_slices == 2 and env.slice_id == 1

@@ -100,8 +100,25 @@ def test_jobset_rank_env_contract():
     assert "EDL_TPU_COORDINATOR" in env
 
 
+def test_ctr_job_wires_task_dispenser_elasticity():
+    """The CTR job's elasticity is the TaskMaster lease loop: every
+    trainer must point at the in-bundle store and carry a unique
+    trainer id (pod name) so leases re-dispense on pod death."""
+    with open(os.path.join(K8S_DIR, "ctr-train.yaml")) as f:
+        doc = yaml.safe_load(f)
+    assert doc["kind"] == "Job"
+    tmpl = doc["spec"]["template"]["spec"]
+    args = tmpl["containers"][0]["args"]
+    assert any("edl-store" in a and ":2379" in a for a in args), args
+    assert any(a.startswith("--trainer-id=$(POD_NAME)") for a in args)
+    env = {e["name"] for e in tmpl["containers"][0]["env"]}
+    assert "POD_NAME" in env
+    # scaling parallelism is the elastic knob; completions bounds it
+    assert doc["spec"]["parallelism"] >= 1
+
+
 @pytest.mark.parametrize("fname", ["train-job.yaml", "train-jobset.yaml",
-                                   "edl-store.yaml",
+                                   "edl-store.yaml", "ctr-train.yaml",
                                    "distill-serving.yaml"])
 def test_each_file_parses(fname):
     with open(os.path.join(K8S_DIR, fname)) as f:

@@ -84,6 +84,58 @@ def train(a, data_dir, work, tag, epochs, *, ckpt=None, teachers="",
         return json.load(f)["final"]
 
 
+def measure_topk_mass(a, ckpt: str, data_dir: str, ks: list[int],
+                      temperature: float) -> list[dict]:
+    """Retained softmax mass of the TRAINED teacher at `temperature` for
+    each K — the fraction of the tempered distribution the top-k wire
+    ships. Measured on the val shard with the restored checkpoint (the
+    data the quality numbers are scored on), in-process: this is a
+    forward pass, not a training phase."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from edl_tpu import models as zoo
+    from edl_tpu.train.checkpoint import CheckpointManager
+    from edl_tpu.train.classification import create_state
+
+    model = zoo.get_model(a.model)(num_classes=a.classes)
+    state = create_state(model, jax.random.PRNGKey(0),
+                         (1, a.image_size, a.image_size, 3),
+                         optax.identity())
+    restored = CheckpointManager(ckpt).restore_raw()
+    if restored is None:
+        raise SystemExit(f"no teacher checkpoint under {ckpt}")
+    raw = restored[0]
+    state = state.replace(params=raw["params"],
+                          batch_stats=raw.get("batch_stats")
+                          or state.batch_stats)
+    variables = {"params": state.params}
+    if state.batch_stats is not None:
+        variables["batch_stats"] = state.batch_stats
+    forward = jax.jit(lambda x: state.apply_fn(variables, x, train=False))
+
+    val = np.load(os.path.join(data_dir, "val.npz"))
+    images = val["image"].astype(np.float32)
+    bs = min(128, len(images))
+    sums = {k: [] for k in ks}
+    for lo in range(0, len(images) - bs + 1, bs):
+        logits = np.asarray(forward(jnp.asarray(images[lo:lo + bs])),
+                            dtype=np.float64)
+        z = logits / temperature
+        z -= z.max(axis=-1, keepdims=True)
+        prob = np.exp(z)
+        prob /= prob.sum(axis=-1, keepdims=True)
+        cum = np.cumsum(np.sort(prob, axis=-1)[:, ::-1], axis=-1)
+        for k in ks:
+            sums[k].append(cum[:, min(k, prob.shape[-1]) - 1].mean())
+    return [{"topk": k,
+             "mass": round(float(np.mean(sums[k])), 4),
+             "wire_bytes_per_row": k * 6}  # int32 idx + fp16 val
+            for k in ks]
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="tools/distill_quality_tpu.py")
     p.add_argument("--out", default="DISTILL_QUALITY_r5.json")
@@ -114,6 +166,11 @@ def main(argv=None) -> int:
     p.add_argument("--serve-topk", type=int, default=0,
                    help=">0: ALSO run the compressed-wire distilled "
                         "student and record its delta")
+    p.add_argument("--mass-topk", default="",
+                   help="comma list of K values: measure the trained "
+                        "teacher's retained softmax mass at the distill "
+                        "temperature for each K (the top-k wire's "
+                        "quality-safety number) on the val shard")
     p.add_argument("--phase-timeout", type=int, default=2400)
     p.add_argument("--reuse-teacher", action="store_true",
                    help="skip teacher training when its checkpoint and "
@@ -198,6 +255,11 @@ def main(argv=None) -> int:
     finally:
         tsrv.kill()
 
+    mass_points = None
+    if a.mass_topk:
+        ks = [int(k) for k in a.mass_topk.split(",") if k]
+        mass_points = measure_topk_mass(a, ckpt, full, ks, a.temperature)
+
     delta = distilled["acc1"] - alone["acc1"]
     report = {
         "clause": "same student/subset/steps/LR; only the loss target "
@@ -223,6 +285,17 @@ def main(argv=None) -> int:
                            "examples/imagenet_train --teachers"},
         "wall_s": round(time.time() - t0, 1),
     }
+    if mass_points is not None:
+        report["topk_mass"] = {
+            "note": "fraction of the trained teacher's temperature-"
+                    f"{a.temperature:g} softmax retained by the top K of "
+                    f"{a.classes} classes (val shard; the top-k wire "
+                    "ships exactly this mass). Guidance: pick K for "
+                    ">=99% retained mass at the distill temperature.",
+            "temperature": a.temperature,
+            "classes": a.classes,
+            "points": mass_points,
+        }
     with open(a.out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps({k: report[k] for k in
