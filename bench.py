@@ -129,11 +129,18 @@ def bench_resnet(on_tpu: bool) -> dict:
     dt = time.perf_counter() - t0
     imgs_per_sec = steps * batch_size / dt
 
-    # -- extras: full input pipeline (host -> device each step) ------------
+    # -- extras: full input pipeline (host -> device each step), fed
+    # through the MP shared-memory loader (the DALI multi-worker feed
+    # role — worker processes collate into shm slots, the parent
+    # device_puts zero-copy views) ----------------------------------------
+    mp_workers = 4 if on_tpu else 2
+    mp_loader = DataLoader(source, batch_size, transforms=(random_flip_lr,),
+                           num_workers=mp_workers)
+
     def batches():
         epoch = 1
         while True:
-            yield from loader.epoch(epoch)
+            yield from mp_loader.epoch(epoch)
             epoch += 1
 
     it = prefetch_to_device(batches(), sharding, size=4)
@@ -145,11 +152,13 @@ def bench_resnet(on_tpu: bool) -> dict:
     _sync(metrics["loss"])
     pipe_dt = time.perf_counter() - t0
     it.close()
+    mp_loader.close()
     pipe_imgs_per_sec = pipe_steps * batch_size / pipe_dt
 
     per_accel = imgs_per_sec / n_dev
     return {"imgs_per_sec": round(imgs_per_sec, 1),
             "pipeline_imgs_per_sec": round(pipe_imgs_per_sec, 1),
+            "pipeline_loader_workers": mp_workers,
             "vs_baseline": round(per_accel / (1828.0 / 8.0), 3)}
 
 
@@ -188,35 +197,53 @@ def bench_input_plane(on_tpu: bool) -> dict:
                                                 hw=hw, seed=0)
         src = JpegFileListSource(list_file, root=d)
         batch_size = 128 if on_tpu else 32
-        loader = DataLoader(
+
+        def timed_run(loader) -> float:
+            it = iter(loader.epoch(0))
+            next(it)  # warm the pool/workers + page cache
+            n = 0
+            t0 = time.perf_counter()
+
+            def batches_forever():
+                epoch = 1
+                while True:
+                    yield from loader.epoch(epoch)
+                    epoch += 1
+
+            for batch in batches_forever():
+                n += len(batch["label"])
+                if n >= batches * batch_size:
+                    break
+            dt = time.perf_counter() - t0
+            loader.close()
+            return n / dt
+
+        imgs_per_sec = timed_run(DataLoader(
             src, batch_size,
             sample_transforms=(train_image_transform(size),),
-            decode_threads=threads)
-        it = iter(loader.epoch(0))
-        next(it)  # warm the pool + page cache
-        n = 0
-        t0 = time.perf_counter()
+            decode_threads=threads))
 
-        def batches_forever():
-            epoch = 1
-            while True:
-                yield from loader.epoch(epoch)
-                epoch += 1
-
-        for batch in batches_forever():
-            n += len(batch["label"])
-            if n >= batches * batch_size:
-                break
-        dt = time.perf_counter() - t0
-        loader.close()
+        # MP shared-memory worker pool over the SAME plane: worker
+        # PROCESSES sidestep the GIL that caps the thread pool once
+        # Python-side transform/collation code dominates. On an N-core
+        # host this scales ~linearly to min(workers, N); on a 1-core
+        # host it measures the IPC overhead instead (scaling < 1).
+        mp_workers = 4
+        mp_imgs_per_sec = timed_run(DataLoader(
+            src, batch_size,
+            sample_transforms=(train_image_transform(size),),
+            num_workers=mp_workers))
     finally:
         shutil.rmtree(d, ignore_errors=True)
-    imgs_per_sec = n / dt
     per_core = imgs_per_sec / max(1, min(threads, cores))
     return {"imgs_per_sec": round(imgs_per_sec, 1),
             "threads": threads,
             "host_cores": cores,
-            "imgs_per_sec_per_core": round(per_core, 1)}
+            "imgs_per_sec_per_core": round(per_core, 1),
+            "mp_imgs_per_sec": round(mp_imgs_per_sec, 1),
+            "mp_workers": mp_workers,
+            "mp_scaling": round(mp_imgs_per_sec / max(imgs_per_sec, 1e-9),
+                                2)}
 
 
 def bench_flash_kernel(on_tpu: bool) -> dict:
@@ -901,6 +928,20 @@ def main() -> None:
             # host cores at which the loader saturates the chip rate
             # (v5e TPU-VM hosts have 112 vCPU)
             "loader_cores_to_feed_headline": round(cores_to_feed, 1),
+            # multi-process shared-memory loader (DataLoader
+            # num_workers): worker processes + shm ring hand-off —
+            # the past-the-GIL path; scaling is vs the threaded
+            # single-process number above (≈linear to min(workers,
+            # cores) on real multi-core hosts, <1 on a 1-core host
+            # where it can only measure IPC overhead)
+            "loader_imgs_per_sec_mp": loader["mp_imgs_per_sec"],
+            "loader_mp_workers": loader["mp_workers"],
+            "loader_mp_scaling": loader["mp_scaling"],
+            # resnet pipeline number above is now captured through the
+            # mp loader feed (workers collate into shm, parent
+            # device_puts zero-copy views)
+            "resnet_pipeline_loader_workers":
+                resnet["pipeline_loader_workers"],
             "transformer_tokens_per_sec": transformer["tokens_per_sec"],
             "transformer_mfu": transformer["mfu"],
             # r5: the perf-notes prediction measured — MFU past the

@@ -130,7 +130,14 @@ def main(argv=None) -> int:
                              "on-device normalization")
     parser.add_argument("--decode-threads", type=int,
                         default=max(1, (os.cpu_count() or 1) - 1),
-                        help="JPEG decode/augment pool width")
+                        help="JPEG decode/augment THREAD pool width "
+                             "(ignored when --loader-workers > 0)")
+    parser.add_argument("--loader-workers", type=int, default=None,
+                        help="input-plane worker PROCESSES with "
+                             "shared-memory batch hand-off — scales the "
+                             "host loader past the GIL (default: "
+                             "$EDL_TPU_LOADER_WORKERS, else 0 = "
+                             "inline/threaded)")
     parser.add_argument("--make-synthetic", type=int, default=0,
                         help="generate N train shards (+1 val) first "
                              "(jpeg format: N random JPEGs + train.txt)")
@@ -276,7 +283,8 @@ def main(argv=None) -> int:
                                                rotate=args.rotate))
         loader = DataLoader(source, local_bs, rank=rank, world=world,
                             seed=args.seed, sample_transforms=(sample_t,),
-                            decode_threads=args.decode_threads)
+                            decode_threads=args.decode_threads,
+                            num_workers=args.loader_workers)
         normalize = "imagenet"  # uint8 off the wire; normalize on chip
         n_files = len(source)
     else:
@@ -288,7 +296,8 @@ def main(argv=None) -> int:
         source = FileSource(files)
         transforms = () if args.no_augment else (random_flip_lr, random_crop)
         loader = DataLoader(source, local_bs, rank=rank, world=world,
-                            seed=args.seed, transforms=transforms)
+                            seed=args.seed, transforms=transforms,
+                            num_workers=args.loader_workers)
         n_files = len(files)
     steps_per_epoch = loader.steps_per_epoch()
     log.info("world=%d rank=%d devices=%d format=%s shards=%d samples=%d "
@@ -428,6 +437,10 @@ def main(argv=None) -> int:
             it = loader.epoch(epoch)
         return prefetch_to_device(it, data_sharding) \
             if jax.process_count() == 1 else it
+
+    # TrainLoop closes the data plane it drives (decode pool / mp
+    # workers + shm ring) when the run ends, crash paths included
+    data_fn.close = loader.close
 
     try:
         status = loop.run(data_fn)

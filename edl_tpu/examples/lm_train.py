@@ -64,6 +64,10 @@ def main(argv=None) -> int:
     parser.add_argument("--data-dir", required=True)
     parser.add_argument("--make-synthetic", type=int, default=0)
     parser.add_argument("--rows-per-file", type=int, default=512)
+    parser.add_argument("--loader-workers", type=int, default=None,
+                        help="input-plane worker PROCESSES with "
+                             "shared-memory batch hand-off (default: "
+                             "$EDL_TPU_LOADER_WORKERS, else 0 = inline)")
     parser.add_argument("--vocab", type=int, default=512)
     parser.add_argument("--seq-len", type=int, default=256)
     parser.add_argument("--d-model", type=int, default=256)
@@ -164,7 +168,7 @@ def main(argv=None) -> int:
 
     source = FileSource(files)
     loader = DataLoader(source, local_bs, rank=rank, world=world,
-                        seed=args.seed)
+                        seed=args.seed, num_workers=args.loader_workers)
     steps_per_epoch = loader.steps_per_epoch()
     total_steps = steps_per_epoch * (args.schedule_epochs or args.epochs)
     # --batch-size is GLOBAL: LR stays batch-tied across elastic resizes
@@ -241,8 +245,11 @@ def main(argv=None) -> int:
         eval_fn=eval_fn,
         place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t))
 
-    status = loop.run(lambda epoch: ({"tokens": b["tokens"]}
-                                     for b in loader.epoch(epoch)))
+    def data_fn(epoch):
+        return ({"tokens": b["tokens"]} for b in loader.epoch(epoch))
+
+    data_fn.close = loader.close  # TrainLoop tears down the mp workers
+    status = loop.run(data_fn)
     if rank == 0 and args.benchmark_log:
         blog.write(args.benchmark_log, rank)
     final = blog.finalize().get("final", {})

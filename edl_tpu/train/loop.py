@@ -53,6 +53,12 @@ class LoopConfig:
     # i+1 hides under step i (H2D overlap — the distill serving path's
     # student-side half). 0 = place inline on the training thread.
     prefetch_batches: int = field(0, env="EDL_TPU_PREFETCH_BATCHES")
+    # Input-plane worker processes (DataLoader num_workers): the
+    # shared-memory mp loader that scales host decode/augment past the
+    # GIL (data/mp_loader.py). 0 = inline/threaded path. Entrypoints
+    # pass this through to the DataLoader they build; DataLoader itself
+    # also honors the same env var when num_workers is left unset.
+    loader_workers: int = field(0, env="EDL_TPU_LOADER_WORKERS")
 
 
 class TrainLoop:
@@ -182,6 +188,15 @@ class TrainLoop:
             # record expires instead of being kept fresh forever.
             if self._util_publisher is not None:
                 self._util_publisher.stop()
+            # The loop owns the lifetime of the data plane it drives: a
+            # data_fn with a close() (DataLoader is callable and is one;
+            # examples attach loader.close to their wrappers) gets its
+            # decode pool / worker processes joined and shm unlinked —
+            # including on the crash path, where an abandoned mp pool
+            # would otherwise linger until GC.
+            closer = getattr(data_fn, "close", None)
+            if callable(closer):
+                closer()
 
     def _profile_window(self) -> None:
         """Start/stop the jax profiler trace at the configured global

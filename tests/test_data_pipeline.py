@@ -77,6 +77,29 @@ def test_transforms_deterministic():
         assert x.shape == (8, 8, 8, 3)
 
 
+@pytest.mark.parametrize("dtype", [np.uint8, np.float32])
+def test_random_crop_vectorized_matches_per_image_loop(dtype):
+    """The batched sliding-window gather must be bit-identical to the
+    per-image loop it replaced (same rng draws, same windows)."""
+    pad = 3
+    imgs = (np.random.default_rng(0).integers(0, 256, size=(6, 12, 10, 3))
+            .astype(dtype))
+    out = random_crop({"image": imgs}, np.random.default_rng(9), pad=pad)
+
+    rng = np.random.default_rng(9)  # the pre-vectorization reference
+    padded = np.pad(imgs, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                    mode="reflect")
+    ys = rng.integers(0, 2 * pad + 1, size=6)
+    xs = rng.integers(0, 2 * pad + 1, size=6)
+    ref = np.empty_like(imgs)
+    for i in range(6):
+        ref[i] = padded[i, ys[i]:ys[i] + 12, xs[i]:xs[i] + 10]
+
+    np.testing.assert_array_equal(out["image"], ref)
+    assert out["image"].dtype == imgs.dtype
+    assert out["image"].flags["C_CONTIGUOUS"]
+
+
 def test_prefetch_preserves_order_and_raises():
     items = list(range(10))
     assert list(prefetch(iter(items), size=3)) == items

@@ -1,0 +1,362 @@
+"""Multi-process shared-memory loader (data/mp_loader.py + shm_ring.py).
+
+The acceptance surface of the mp input plane:
+- bit-identical batch streams across ALL execution modes (inline,
+  decode_threads thread pool, num_workers process pool),
+- replay-after-restart from a mid-epoch cursor,
+- a SIGKILL'd worker loses nothing (batches arrive in order, exactly
+  once) and a poisoned sample surfaces the worker traceback,
+- every shm segment is unlinked on close / GC / TrainLoop teardown
+  (the /dev/shm leak check).
+
+No pytest-timeout in the image: hang-prone paths run under a SIGALRM
+`deadline()` so a wedged queue fails the test instead of the suite.
+"""
+
+import contextlib
+import gc
+import os
+import signal
+import numpy as np
+import pytest
+
+from edl_tpu.data.pipeline import (ArraySource, DataLoader,
+                                   prefetch_to_device, random_crop,
+                                   random_flip_lr)
+from edl_tpu.utils.exceptions import EdlDataError
+
+
+def shm_segments() -> set:
+    # rings are always created by the parent, so OUR segments carry this
+    # process's pid in the name — scoping the leak check to them keeps
+    # it meaningful when other edl processes run on the host
+    prefix = f"edl_mp_{os.getpid()}_"
+    try:
+        return {n for n in os.listdir("/dev/shm")
+                if n.startswith(prefix)}
+    except FileNotFoundError:  # pragma: no cover - non-Linux
+        return set()
+
+
+@contextlib.contextmanager
+def deadline(seconds: int):
+    """Fail (don't hang) if the block exceeds `seconds`."""
+
+    def fire(signum, frame):
+        raise TimeoutError(f"test exceeded {seconds}s deadline")
+
+    old = signal.signal(signal.SIGALRM, fire)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def array_source(n=64, hw=12):
+    rng = np.random.default_rng(0)
+    return ArraySource({
+        "image": rng.integers(0, 256, size=(n, hw, hw, 3), dtype=np.uint8),
+        "label": np.arange(n, dtype=np.int32)})
+
+
+AUG = (random_flip_lr, lambda b, r: random_crop(b, r, pad=2))
+
+
+def copy_stream(it):
+    return [{k: np.array(v) for k, v in b.items()} for b in it]
+
+
+def assert_streams_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert set(x) == set(y)
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k])
+
+
+@pytest.fixture(scope="module")
+def jpeg_dir(tmp_path_factory):
+    from edl_tpu.data.image import make_synthetic_jpeg_dataset
+    d = tmp_path_factory.mktemp("mp_jpegs")
+    list_file = make_synthetic_jpeg_dataset(str(d), 24, classes=5,
+                                            hw=(60, 80), seed=7)
+    return str(d), list_file
+
+
+def jpeg_loader(jpeg_dir, **kw):
+    from edl_tpu.data.image import JpegFileListSource, train_image_transform
+    root, list_file = jpeg_dir
+    return DataLoader(JpegFileListSource(list_file, root=root), 4, seed=5,
+                      sample_transforms=(train_image_transform(16),), **kw)
+
+
+class TestDeterminismAcrossModes:
+    """One contract, three executors: the batch stream is a pure
+    function of (seed, epoch, rank, step) whatever runs it."""
+
+    @pytest.mark.parametrize("mode", [dict(decode_threads=2),
+                                      dict(num_workers=1),
+                                      dict(num_workers=3)])
+    def test_jpeg_plane_bit_identical(self, jpeg_dir, mode):
+        with deadline(120):
+            with jpeg_loader(jpeg_dir) as inline:
+                want = copy_stream(inline.epoch(3))
+            with jpeg_loader(jpeg_dir, **mode) as ld:
+                got = copy_stream(ld.epoch(3))
+        assert_streams_equal(want, got)
+        assert not shm_segments()
+
+    @pytest.mark.parametrize("workers", [1, 2])
+    def test_batch_transform_plane_bit_identical(self, workers):
+        src = array_source()
+        with deadline(120):
+            with DataLoader(src, 4, seed=3, transforms=AUG) as inline:
+                want = copy_stream(inline.epoch(1))
+            with DataLoader(src, 4, seed=3, transforms=AUG,
+                            num_workers=workers) as ld:
+                got = copy_stream(ld.epoch(1))
+        assert_streams_equal(want, got)
+        assert not shm_segments()
+
+    def test_epoch_reuses_pool_and_streams_differ_by_epoch(self):
+        src = array_source()
+        with deadline(120), DataLoader(src, 4, seed=3, transforms=AUG,
+                                       num_workers=2) as ld:
+            a = copy_stream(ld.epoch(0))
+            pool = ld._mp_pool
+            b = copy_stream(ld.epoch(1))
+            assert ld._mp_pool is pool  # workers survive epochs
+        assert not np.array_equal(a[0]["image"], b[0]["image"])
+        assert not shm_segments()
+
+
+class TestReplayAfterRestart:
+    def test_mid_epoch_cursor_replays_remainder(self):
+        src = array_source()
+        with deadline(120):
+            with DataLoader(src, 4, seed=9, transforms=AUG) as inline:
+                full = copy_stream(inline.epoch(2))
+            # first process consumes 3 batches then "dies"
+            with DataLoader(src, 4, seed=9, transforms=AUG,
+                            num_workers=2) as before:
+                it = before.epoch(2)
+                head = [next(it) for _ in range(3)]
+                head = [{k: np.array(v) for k, v in b.items()}
+                        for b in head]
+                it.close()  # mid-epoch abandon (stop-resume)
+            # restarted process resumes from the step_in_epoch cursor
+            with DataLoader(src, 4, seed=9, transforms=AUG,
+                            num_workers=2) as after:
+                tail = copy_stream(after.epoch(2, start_step=3))
+        assert_streams_equal(head + tail, full)
+        assert not shm_segments()
+
+    def test_skip_by_iteration_matches_cursor(self, jpeg_dir):
+        """TrainLoop skips by consuming; epoch(start_step=) must land on
+        the same stream (same parent-side seed draws either way)."""
+        with deadline(120), jpeg_loader(jpeg_dir, num_workers=2) as ld:
+            it = ld.epoch(1)
+            for _ in range(2):
+                next(it)
+            want = copy_stream(it)
+            got = copy_stream(ld.epoch(1, start_step=2))
+        assert_streams_equal(want, got)
+        assert not shm_segments()
+
+
+class TestWorkerDeath:
+    def test_sigkill_mid_epoch_exactly_once_in_order(self):
+        src = array_source(n=96)
+        with deadline(120):
+            with DataLoader(src, 4, seed=3, transforms=AUG) as inline:
+                want = copy_stream(inline.epoch(7))
+            with DataLoader(src, 4, seed=3, transforms=AUG,
+                            num_workers=2) as ld:
+                got = copy_stream(ld.epoch(0))  # builds the pool
+                it = ld.epoch(7)
+                got = [{k: np.array(v) for k, v in next(it).items()}]
+                os.kill(ld._mp_pool._procs[0].pid, signal.SIGKILL)
+                got += copy_stream(it)
+        assert_streams_equal(want, got)  # nothing lost, nothing doubled
+        assert not shm_segments()
+
+    def test_all_workers_dead_raises_instead_of_hanging(self):
+        src = array_source()
+        with deadline(60):
+            with DataLoader(src, 4, seed=3, num_workers=1) as ld:
+                list(ld.epoch(0))  # pool up
+                it = ld.epoch(1)
+                next(it)
+                os.kill(ld._mp_pool._procs[0].pid, signal.SIGKILL)
+                with pytest.raises(EdlDataError, match="died"):
+                    list(it)
+        assert not shm_segments()
+
+    def test_poisoned_sample_surfaces_worker_traceback(self):
+        src = array_source()
+
+        def poison(batch, rng):
+            if (batch["label"] == 13).any():
+                raise ValueError("pixel 13 is cursed")
+            return batch
+
+        with deadline(60):
+            with DataLoader(src, 4, seed=3, transforms=(poison,),
+                            num_workers=2) as ld:
+                with pytest.raises(EdlDataError) as err:
+                    list(ld.epoch(0))
+        assert "pixel 13 is cursed" in str(err.value)
+        assert "Traceback" in str(err.value)  # the WORKER's stack
+        assert not shm_segments()
+
+
+class TestLifecycle:
+    def test_close_is_idempotent_and_loader_reusable(self):
+        src = array_source()
+        with deadline(120):
+            ld = DataLoader(src, 4, seed=0, num_workers=1)
+            a = copy_stream(ld.epoch(0))
+            ld.close()
+            ld.close()
+            assert not shm_segments()
+            b = copy_stream(ld.epoch(0))  # pool rebuilds lazily
+            ld.close()
+        assert_streams_equal(a, b)
+        assert not shm_segments()
+
+    def test_gc_of_abandoned_loader_unlinks_shm(self):
+        with deadline(60):
+            ld = DataLoader(array_source(), 4, seed=0, num_workers=1)
+            it = ld.epoch(0)
+            next(it)  # pool + ring live, iterator abandoned mid-epoch
+            del it, ld
+            gc.collect()
+        assert not shm_segments()
+
+    def test_train_loop_closes_the_loader_it_drives(self):
+        from edl_tpu.train.loop import LoopConfig, TrainLoop
+
+        ld = DataLoader(array_source(), 8, seed=1, num_workers=1)
+        seen = []
+
+        def step(state, batch):
+            seen.append(int(batch["label"][0]))
+            return state, {"loss": 0.0}
+
+        with deadline(120):
+            loop = TrainLoop(step, state=0, mesh=None,
+                             config=LoopConfig(num_epochs=1,
+                                               log_every_steps=1000))
+            loop.run(ld)  # DataLoader IS the data_fn (callable)
+        assert len(seen) == ld.steps_per_epoch()
+        assert ld._mp_pool is None  # run()'s finally closed it
+        assert not shm_segments()
+
+    def test_prefetch_to_device_over_mp_views(self):
+        """The bench/train feed: device placement happens before the
+        prefetch worker advances the iterator, so zero-copy shm views
+        are safe to pipeline (batch i is on device before slot i can
+        recycle)."""
+        import jax
+
+        from edl_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": 8}))
+        sharding = mesh_lib.data_sharding(mesh)
+        src = array_source()
+        with deadline(120):
+            with DataLoader(src, 8, seed=4, transforms=AUG) as inline:
+                want = copy_stream(inline.epoch(0))
+            with DataLoader(src, 8, seed=4, transforms=AUG,
+                            num_workers=2) as ld:
+                got = [jax.device_get(b) for b in
+                       prefetch_to_device(ld.epoch(0), sharding, size=2)]
+        assert_streams_equal(want, got)
+        assert not shm_segments()
+
+
+class TestShmRing:
+    def test_write_read_roundtrip_and_alignment(self):
+        from edl_tpu.data import shm_ring
+
+        batch = {"image": np.arange(48, dtype=np.uint8).reshape(4, 4, 3),
+                 "label": np.arange(4, dtype=np.int64)}
+        ring = shm_ring.ShmRing(shm_ring.batch_nbytes(batch), 2)
+        try:
+            meta = shm_ring.write_batch(ring.buf(0), batch)
+            assert meta is not None
+            assert all(off % 64 == 0 for _, _, _, off in meta)
+            out = shm_ring.read_batch(ring.buf(0), meta)
+            assert_streams_equal([batch], [out])
+        finally:
+            ring.close()
+        assert not shm_segments()
+
+    def test_oversized_batch_returns_none(self):
+        from edl_tpu.data import shm_ring
+
+        ring = shm_ring.ShmRing(64, 1)
+        try:
+            big = {"x": np.zeros(1024, np.float32)}
+            assert shm_ring.write_batch(ring.buf(0), big) is None
+        finally:
+            ring.close()
+        assert not shm_segments()
+
+    def test_close_tolerates_live_views_and_is_idempotent(self):
+        from edl_tpu.data import shm_ring
+
+        batch = {"x": np.arange(16, dtype=np.float32)}
+        ring = shm_ring.ShmRing(shm_ring.batch_nbytes(batch), 1)
+        meta = shm_ring.write_batch(ring.buf(0), batch)
+        view = shm_ring.read_batch(ring.buf(0), meta)["x"]
+        ring.close()  # view still alive: name must go, no crash
+        ring.close()
+        assert not shm_segments()
+        np.testing.assert_array_equal(view, batch["x"])  # mapping lives
+
+    def test_spill_fallback_keeps_stream_correct(self):
+        """A batch that outgrows its slot ships over the queue instead
+        of failing (shape drift after the sizing probe)."""
+        from edl_tpu.data.mp_loader import MpLoaderPool
+
+        src = array_source(n=32)
+        pool = MpLoaderPool(src, (), (), num_workers=1, slot_bytes=64)
+        try:
+            descs = [(i, np.arange(i * 4, i * 4 + 4), None, None)
+                     for i in range(8)]
+            with deadline(60):
+                got = copy_stream(pool.imap(descs))
+            want = [src.batch(np.arange(i * 4, i * 4 + 4))
+                    for i in range(8)]
+            assert_streams_equal(want, got)
+        finally:
+            pool.close()
+        assert not shm_segments()
+
+
+@pytest.mark.slow
+class TestStress:
+    def test_churny_epochs_stay_deterministic(self):
+        """10 epochs at 4 workers with a worker SIGKILL'd each even
+        epoch: every stream bit-identical to inline, no leaks."""
+        src = array_source(n=128)
+        with deadline(300):
+            with DataLoader(src, 4, seed=11, transforms=AUG) as inline, \
+                    DataLoader(src, 4, seed=11, transforms=AUG,
+                               num_workers=4) as ld:
+                list(ld.epoch(0))  # pool up
+                for epoch in range(10):
+                    want = copy_stream(inline.epoch(epoch))
+                    it = ld.epoch(epoch)
+                    got = [{k: np.array(v) for k, v in next(it).items()}]
+                    if epoch % 2 == 0:
+                        victims = [p for p in ld._mp_pool._procs
+                                   if p.is_alive()]
+                        if len(victims) > 1:
+                            os.kill(victims[0].pid, signal.SIGKILL)
+                    got += copy_stream(it)
+                    assert_streams_equal(want, got)
+        assert not shm_segments()

@@ -1,0 +1,93 @@
+"""One-shot input-plane tuner: img/s at loader workers in {0, 1, 2, 4}.
+
+Local sizing companion to the mp shared-memory loader
+(edl_tpu/data/mp_loader.py): generates a synthetic JPEG dataset, runs
+the decode + random-resized-crop + flip plane at each worker count and
+prints a small table, so picking `--loader-workers` /
+`EDL_TPU_LOADER_WORKERS` for a host is one command instead of a sweep
+by hand.  workers=0 is the inline path; pass --decode-threads to also
+see the thread-pool variant at width 0.
+
+  python tools/loader_bench.py --n-imgs 256 --size 128 --batches 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/loader_bench.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
+
+
+def measure(loader, batches: int, batch_size: int) -> float:
+    it = iter(loader.epoch(0))
+    next(it)  # warm workers + page cache outside the timed window
+    n = 0
+    t0 = time.perf_counter()
+
+    def forever():
+        epoch = 1
+        while True:
+            yield from loader.epoch(epoch)
+            epoch += 1
+
+    for batch in forever():
+        n += len(batch["label"])
+        if n >= batches * batch_size:
+            break
+    dt = time.perf_counter() - t0
+    loader.close()
+    return n / dt
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tools/loader_bench.py")
+    parser.add_argument("--n-imgs", type=int, default=256)
+    parser.add_argument("--size", type=int, default=128,
+                        help="crop size (224 = the real train plane)")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--batches", type=int, default=4,
+                        help="timed batches per worker count")
+    parser.add_argument("--workers", type=int, nargs="+",
+                        default=[0, 1, 2, 4])
+    parser.add_argument("--decode-threads", type=int, default=0,
+                        help="thread pool width for the workers=0 row")
+    args = parser.parse_args(argv)
+
+    from edl_tpu.data.image import (JpegFileListSource,
+                                    make_synthetic_jpeg_dataset,
+                                    train_image_transform)
+    from edl_tpu.data.pipeline import DataLoader
+
+    d = tempfile.mkdtemp(prefix="edl-loader-bench-")
+    try:
+        list_file = make_synthetic_jpeg_dataset(
+            d, args.n_imgs, classes=100,
+            hw=(args.size * 3 // 2, args.size * 2), seed=0)
+        src = JpegFileListSource(list_file, root=d)
+        print(f"host cores: {os.cpu_count()}  images: {args.n_imgs}  "
+              f"crop: {args.size}px  batch: {args.batch_size}")
+        print(f"{'workers':>8} {'img/s':>10} {'vs workers=0':>13}")
+        base = None
+        for w in args.workers:
+            loader = DataLoader(
+                src, args.batch_size,
+                sample_transforms=(train_image_transform(args.size),),
+                decode_threads=args.decode_threads if w == 0 else 0,
+                num_workers=w)
+            rate = measure(loader, args.batches, args.batch_size)
+            base = base if base is not None else rate
+            print(f"{w:>8} {rate:>10.1f} {rate / base:>12.2f}x")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
