@@ -199,8 +199,15 @@ def bench_input_plane(on_tpu: bool) -> dict:
         batch_size = 128 if on_tpu else 32
 
         def timed_run(loader) -> float:
+            # Two warm-up batches: the first is the page-cache/pool warm
+            # (mp: the in-parent probe that sizes the shm ring), the
+            # SECOND is what actually forks the mp workers and builds
+            # the ring — with one, worker startup (and a second
+            # in-parent probe) would land inside the timed window.
             it = iter(loader.epoch(0))
-            next(it)  # warm the pool/workers + page cache
+            next(it)
+            next(it, None)
+            it.close()  # mp: drain in-flight slots; pool stays warm
             n = 0
             t0 = time.perf_counter()
 
@@ -938,8 +945,9 @@ def main() -> None:
             "loader_mp_workers": loader["mp_workers"],
             "loader_mp_scaling": loader["mp_scaling"],
             # resnet pipeline number above is now captured through the
-            # mp loader feed (workers collate into shm, parent
-            # device_puts zero-copy views)
+            # mp loader feed (workers collate into shm; the parent
+            # copies each ring view before device_put so the placed
+            # batch can't alias a recycled slot)
             "resnet_pipeline_loader_workers":
                 resnet["pipeline_loader_workers"],
             "transformer_tokens_per_sec": transformer["tokens_per_sec"],

@@ -502,11 +502,23 @@ def prefetch(it: Iterable, size: int = 2,
 
 def prefetch_to_device(it: Iterable, sharding, size: int = 2) -> Iterator:
     """Prefetch + device placement: batches land sharded on the mesh while
-    the previous step computes (H2D overlap)."""
+    the previous step computes (H2D overlap).
+
+    Borrowed views (OWNDATA=False — e.g. the mp loader's shm-ring
+    batches) are copied before placement: `jax.device_put` zero-copies
+    suitably aligned host buffers on the CPU backend (the placed Array
+    ALIASES the numpy memory) and DMAs asynchronously on TPU, so placing
+    a ring view directly would hand the step memory that a worker
+    process rewrites as soon as the slot recycles."""
+
+    def _place_one(x):
+        x = np.asarray(x)
+        if not x.flags["OWNDATA"]:
+            x = np.array(x)
+        return jax.device_put(x, sharding)
 
     def place(batch):
-        return jax.tree.map(
-            lambda x: jax.device_put(np.asarray(x), sharding), batch)
+        return jax.tree.map(_place_one, batch)
 
     return prefetch(it, size=size, place=place)
 

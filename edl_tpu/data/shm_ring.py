@@ -133,10 +133,18 @@ class ShmRing:
                 # the mapping's lifetime to the view chain (the mmap
                 # unmaps when the last view dies) and close the fd now —
                 # leaving close() to retry in __del__ would just raise
-                # the same BufferError unraisably at GC.
-                shm._buf = None
-                shm._mmap = None
-                if shm._fd >= 0:
-                    os.close(shm._fd)
-                    shm._fd = -1
+                # the same BufferError unraisably at GC.  The surgery
+                # pokes SharedMemory privates whose names/layout drift
+                # across CPython versions, so any miss degrades to
+                # leaving teardown to the view chain (nothing leaks:
+                # the /dev/shm name is gone), never to a crash.
+                try:
+                    fd = shm._fd
+                    shm._buf = None
+                    shm._mmap = None
+                    if isinstance(fd, int) and fd >= 0:
+                        os.close(fd)
+                        shm._fd = -1
+                except (AttributeError, OSError):
+                    pass
         self._closed = True

@@ -260,6 +260,17 @@ def main(argv=None) -> int:
                          f"world {world}")
     local_bs = args.batch_size // world
 
+    loop_cfg = from_env(LoopConfig, num_epochs=args.epochs,
+                        ckpt_dir=args.ckpt_dir or env.checkpoint_path
+                        or None,
+                        profile_dir=args.profile or None)
+    # --loader-workers wins when given; otherwise the LoopConfig (its
+    # EDL_TPU_LOADER_WORKERS binding) sets the mp pool width, so the
+    # loop config actually drives the input plane it runs on.
+    loader_workers = (args.loader_workers
+                      if args.loader_workers is not None
+                      else loop_cfg.loader_workers)
+
     # hybrid ICI x DCN when the job is (or declares itself) multi-slice:
     # dp's major dimension crosses DCN, flat dp otherwise
     mesh = distributed.make_mesh_from_env(mesh_lib.MeshSpec({"dp": -1}),
@@ -284,7 +295,7 @@ def main(argv=None) -> int:
         loader = DataLoader(source, local_bs, rank=rank, world=world,
                             seed=args.seed, sample_transforms=(sample_t,),
                             decode_threads=args.decode_threads,
-                            num_workers=args.loader_workers)
+                            num_workers=loader_workers)
         normalize = "imagenet"  # uint8 off the wire; normalize on chip
         n_files = len(source)
     else:
@@ -297,7 +308,7 @@ def main(argv=None) -> int:
         transforms = () if args.no_augment else (random_flip_lr, random_crop)
         loader = DataLoader(source, local_bs, rank=rank, world=world,
                             seed=args.seed, transforms=transforms,
-                            num_workers=args.loader_workers)
+                            num_workers=loader_workers)
         n_files = len(files)
     steps_per_epoch = loader.steps_per_epoch()
     log.info("world=%d rank=%d devices=%d format=%s shards=%d samples=%d "
@@ -421,12 +432,7 @@ def main(argv=None) -> int:
         return results
 
     loop = TrainLoop(
-        step, state, mesh=mesh,
-        config=from_env(LoopConfig, num_epochs=args.epochs,
-                        ckpt_dir=args.ckpt_dir or env.checkpoint_path
-                        or None,
-                        profile_dir=args.profile or None),
-        eval_fn=eval_fn,
+        step, state, mesh=mesh, config=loop_cfg, eval_fn=eval_fn,
         place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t))
 
     def data_fn(epoch):

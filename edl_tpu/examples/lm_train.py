@@ -137,6 +137,16 @@ def main(argv=None) -> int:
         raise SystemExit("global batch not divisible by world")
     local_bs = args.batch_size // world
 
+    loop_cfg = from_env(LoopConfig, num_epochs=args.epochs,
+                        ckpt_dir=args.ckpt_dir or env.checkpoint_path
+                        or None, ckpt_sharded=args.ckpt_sharded,
+                        profile_dir=args.profile or None)
+    # --loader-workers wins when given; otherwise the LoopConfig (its
+    # EDL_TPU_LOADER_WORKERS binding) sets the mp pool width.
+    loader_workers = (args.loader_workers
+                      if args.loader_workers is not None
+                      else loop_cfg.loader_workers)
+
     if args.fsdp and args.mesh != "dp":
         raise SystemExit("--fsdp is a legacy alias of --mesh fsdp; "
                          f"it conflicts with --mesh {args.mesh}")
@@ -168,7 +178,7 @@ def main(argv=None) -> int:
 
     source = FileSource(files)
     loader = DataLoader(source, local_bs, rank=rank, world=world,
-                        seed=args.seed, num_workers=args.loader_workers)
+                        seed=args.seed, num_workers=loader_workers)
     steps_per_epoch = loader.steps_per_epoch()
     total_steps = steps_per_epoch * (args.schedule_epochs or args.epochs)
     # --batch-size is GLOBAL: LR stays batch-tied across elastic resizes
@@ -237,12 +247,7 @@ def main(argv=None) -> int:
         return results
 
     loop = TrainLoop(
-        step, state, mesh=mesh,
-        config=from_env(LoopConfig, num_epochs=args.epochs,
-                        ckpt_dir=args.ckpt_dir or env.checkpoint_path
-                        or None, ckpt_sharded=args.ckpt_sharded,
-                        profile_dir=args.profile or None),
-        eval_fn=eval_fn,
+        step, state, mesh=mesh, config=loop_cfg, eval_fn=eval_fn,
         place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t))
 
     def data_fn(epoch):

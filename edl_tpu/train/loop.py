@@ -55,9 +55,10 @@ class LoopConfig:
     prefetch_batches: int = field(0, env="EDL_TPU_PREFETCH_BATCHES")
     # Input-plane worker processes (DataLoader num_workers): the
     # shared-memory mp loader that scales host decode/augment past the
-    # GIL (data/mp_loader.py). 0 = inline/threaded path. Entrypoints
-    # pass this through to the DataLoader they build; DataLoader itself
-    # also honors the same env var when num_workers is left unset.
+    # GIL (data/mp_loader.py). 0 = inline/threaded path. The imagenet/lm
+    # entrypoints read this as the DataLoader's num_workers whenever the
+    # --loader-workers CLI flag is not given; DataLoader itself also
+    # honors the same env var when num_workers is left unset.
     loader_workers: int = field(0, env="EDL_TPU_LOADER_WORKERS")
 
 

@@ -255,10 +255,10 @@ class TestLifecycle:
         assert not shm_segments()
 
     def test_prefetch_to_device_over_mp_views(self):
-        """The bench/train feed: device placement happens before the
-        prefetch worker advances the iterator, so zero-copy shm views
-        are safe to pipeline (batch i is on device before slot i can
-        recycle)."""
+        """The bench/train feed: prefetch_to_device COPIES borrowed ring
+        views before jax.device_put (which zero-copy aliases aligned
+        numpy memory on the CPU backend), so slot recycling cannot
+        rewrite a batch already handed to the step."""
         import jax
 
         from edl_tpu.parallel import mesh as mesh_lib
@@ -274,6 +274,35 @@ class TestLifecycle:
                 got = [jax.device_get(b) for b in
                        prefetch_to_device(ld.epoch(0), sharding, size=2)]
         assert_streams_equal(want, got)
+        assert not shm_segments()
+
+    def test_placed_batches_do_not_alias_the_ring(self):
+        """Regression: jax.device_put zero-copies aligned numpy views on
+        the CPU backend (the placed Array aliases the shm pages), so
+        prefetch_to_device must copy ring views before placement —
+        otherwise recycling the slot rewrites a batch the step already
+        owns."""
+        import jax
+
+        from edl_tpu.data import shm_ring
+        from edl_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": 8}))
+        sharding = mesh_lib.data_sharding(mesh)
+        batch = {"image": np.arange(8 * 4 * 4 * 3, dtype=np.uint8)
+                 .reshape(8, 4, 4, 3)}
+        ring = shm_ring.ShmRing(shm_ring.batch_nbytes(batch), 1)
+        try:
+            meta = shm_ring.write_batch(ring.buf(0), batch)
+            views = shm_ring.read_batch(ring.buf(0), meta)
+            [placed] = list(prefetch_to_device(iter([views]), sharding))
+            jax.block_until_ready(placed["image"])
+            views["image"][...] = 0  # the slot recycles and is rewritten
+            np.testing.assert_array_equal(jax.device_get(placed["image"]),
+                                          batch["image"])
+            del views, placed
+        finally:
+            ring.close()
         assert not shm_segments()
 
 

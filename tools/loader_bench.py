@@ -26,8 +26,14 @@ if REPO not in sys.path:  # `python tools/loader_bench.py` puts tools/
 
 
 def measure(loader, batches: int, batch_size: int) -> float:
+    # Two warm-up batches: the second next() is what forks the mp
+    # workers and builds the shm ring (the first only yields the
+    # in-parent probe), so worker startup stays outside the timed
+    # window; close() drains in-flight slots, leaving the pool warm.
     it = iter(loader.epoch(0))
-    next(it)  # warm workers + page cache outside the timed window
+    next(it)
+    next(it, None)
+    it.close()
     n = 0
     t0 = time.perf_counter()
 
