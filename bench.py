@@ -885,6 +885,189 @@ def bench_distill_churn(on_tpu: bool) -> dict:
                 float(np.median(rates[steady_steps + churn_steps:])), 1)}
 
 
+def bench_checkpoint(on_tpu: bool) -> dict:
+    """Checkpoint-plane stall: sync full-save vs async snapshot-then-write
+    on the SAME resnet train state bench_resnet measures (the price of
+    elasticity is paid per save — this is what the step loop sees).
+
+    - `ckpt_save_stall_ms_sync`: the legacy epoch-end path — serialize +
+      write + seal, all on the step loop (the sync baseline, captured in
+      the same artifact as the async number);
+    - `ckpt_save_stall_ms`: save_async — the loop blocks only for the
+      device->host snapshot copy; serialization/write/seal ride the
+      background writer (`ckpt_write_s`, overlapped);
+    - `ckpt_restore_s`: restore wall time (parallel chunk-region reads);
+    - `ckpt_bitwise_identical`: sync and async state.msgpack bytes match.
+    Note the 1-core bench host: the win is the step-loop STALL shrinking
+    to the copy, not wall-clock write overlap (no spare core to write on).
+    """
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from edl_tpu.models.resnet import ResNet50_vd
+    from edl_tpu.train import classification as cls
+    from edl_tpu.train.checkpoint import CheckpointManager
+    from edl_tpu.train.state import TrainStatus
+
+    # The REAL resnet headline state both on TPU and in the CPU harness
+    # (ResNetTiny's ~1MB state is all fixed fetch cost, no serialize
+    # cost — it would understate the stall the async path removes); the
+    # CPU world only shrinks the init resolution, params are identical.
+    model = ResNet50_vd(num_classes=1000,
+                        dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    hw = 224 if on_tpu else 32
+    state = cls.create_state(model, jax.random.PRNGKey(0), (1, hw, hw, 3),
+                             optax.sgd(0.1, momentum=0.9, nesterov=True))
+    state_mb = sum(np.asarray(x).nbytes
+                   for x in jax.tree.leaves(state)) / 2**20
+    status = TrainStatus(epoch=0, step=1)
+    root = _tempfile.mkdtemp(prefix="edl-ckpt-bench-")
+    try:
+        sync_dir, async_dir = os.path.join(root, "s"), os.path.join(root, "a")
+
+        def median(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        # sync: full serialize+write stall, median of 3 (fresh manager /
+        # dir per trial so every save writes version 0's full payload)
+        sync_ms, async_ms, write_s = [], [], []
+        for trial in range(3):
+            mgr = CheckpointManager(f"{sync_dir}{trial}", process_index=0)
+            t0 = time.perf_counter()
+            mgr.save(state, status)
+            sync_ms.append((time.perf_counter() - t0) * 1e3)
+
+            mgr = CheckpointManager(f"{async_dir}{trial}", process_index=0)
+            t0 = time.perf_counter()
+            mgr.save_async(state, status)
+            async_ms.append((time.perf_counter() - t0) * 1e3)
+            mgr.close()
+            write_s.append(mgr.stats()["write_s_last"])
+
+        # restore (parallel chunk-region reads happen in sharded mode;
+        # replicated restore is one msgpack read — time it regardless)
+        mgr = CheckpointManager(f"{async_dir}0", process_index=0)
+        fresh = cls.create_state(model, jax.random.PRNGKey(1), (1, hw, hw, 3),
+                                 optax.sgd(0.1, momentum=0.9, nesterov=True))
+        t0 = time.perf_counter()
+        mgr.restore(fresh)
+        restore_s = time.perf_counter() - t0
+
+        with open(os.path.join(f"{sync_dir}0", "ckpt-0",
+                               "state.msgpack"), "rb") as f:
+            sync_bytes = f.read()
+        with open(os.path.join(f"{async_dir}0", "ckpt-0",
+                               "state.msgpack"), "rb") as f:
+            async_bytes = f.read()
+    finally:
+        _shutil.rmtree(root, ignore_errors=True)
+    sync_stall, async_stall = median(sync_ms), median(async_ms)
+    return {"ckpt_save_stall_ms_sync": round(sync_stall, 3),
+            "ckpt_save_stall_ms": round(async_stall, 3),
+            "ckpt_stall_reduction_x": round(sync_stall
+                                            / max(async_stall, 1e-9), 1),
+            "ckpt_write_s": round(median(write_s), 4),
+            "ckpt_restore_s": round(restore_s, 4),
+            "ckpt_bitwise_identical": sync_bytes == async_bytes,
+            "ckpt_state_mb": round(state_mb, 2)}
+
+
+def bench_elastic_downtime(on_tpu: bool) -> dict:
+    """Elastic stop-resume downtime, measured for real: SIGKILL a
+    training process mid-run (checkpoints every few steps, async), then
+    respawn it and clock kill -> first post-restore optimizer step.
+
+    `elastic_downtime_s` = process respawn + world re-formation + restore
+    + re-compile + first step — the full price one membership change
+    costs under the stop-resume elasticity model. The child is the
+    elastic_demo trainer on CPU (hermetic: this harness's TPU tunnel
+    plays no part), so the number calibrates the protocol overhead, not
+    chip speed; `ckpt_restore_s` is parsed from the child's restore log
+    line, and the child's final ckpt_stats JSON supplies the in-run
+    save-stall seen under kill pressure.
+    """
+    import re
+    import shutil as _shutil
+    import signal
+    import subprocess
+    import sys
+    import tempfile as _tempfile
+
+    root = _tempfile.mkdtemp(prefix="edl-downtime-")
+    ckpt_dir = os.path.join(root, "ckpt")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU tunnel
+    env.update({"JAX_PLATFORMS": "cpu", "JAX_NUM_CPU_DEVICES": "1",
+                "EDL_TPU_CHECKPOINT_PATH": ckpt_dir})
+    ckpt_steps, step_time = 5, 0.05
+    cmd = [sys.executable, "-m", "edl_tpu.examples.elastic_demo",
+           "--epochs", "3", "--steps-per-epoch", "40",
+           "--step-time", str(step_time), "--ckpt-steps", str(ckpt_steps)]
+
+    def spawn(log_name):
+        # cwd stays the repo root so the child imports this edl_tpu
+        return subprocess.Popen(
+            cmd, env=env, stdout=open(os.path.join(root, log_name), "wb"),
+            stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+
+    def wait_for(pred, timeout, what):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.05)
+        raise TimeoutError(f"downtime bench: timeout waiting for {what}")
+
+    def log_text(name):
+        try:
+            with open(os.path.join(root, name), "rb") as f:
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    victim = resumed = None
+    try:
+        victim = spawn("run1.log")
+        # let it train past a couple of sealed mid-run checkpoints
+        wait_for(lambda: sum(n.startswith("ckpt-") for n in
+                             (os.listdir(ckpt_dir)
+                              if os.path.isdir(ckpt_dir) else [])) >= 2,
+                 120, "two sealed checkpoints")
+        victim.kill()  # SIGKILL: the crash, not a graceful stop
+        victim.wait(timeout=10)
+        t_kill = time.perf_counter()
+
+        resumed = spawn("run2.log")
+        wait_for(lambda: "first-step-complete" in log_text("run2.log"),
+                 180, "first post-restore step")
+        downtime_s = time.perf_counter() - t_kill
+        resumed.wait(timeout=300)
+        text = log_text("run2.log")
+        m = re.search(r"restored checkpoint .* in ([0-9.]+)s", text)
+        restore_s = float(m.group(1)) if m else None
+        m = re.search(r"ckpt_stats=(\{.*\})", text)
+        child_stats = json.loads(m.group(1)) if m else {}
+        m = re.search(r"first-step-complete global_step=(\d+)", text)
+        resumed_step = int(m.group(1)) if m else None
+    except (TimeoutError, OSError, subprocess.SubprocessError) as exc:
+        print(f"elastic downtime bench failed: {exc}", file=sys.stderr)
+        return {"elastic_downtime_s": None, "ckpt_restore_s": None}
+    finally:
+        for proc in (victim, resumed):
+            if proc is not None and proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+        _shutil.rmtree(root, ignore_errors=True)
+    return {"elastic_downtime_s": round(downtime_s, 2),
+            "ckpt_restore_s": restore_s,
+            "downtime_resumed_at_step": resumed_step,
+            "downtime_ckpt_every_steps": ckpt_steps,
+            "downtime_replay_budget_s": round(ckpt_steps * step_time, 2),
+            "downtime_save_stall_ms_mean":
+                child_stats.get("ckpt_save_stall_ms_mean")}
+
+
 def distill_quality_extras() -> dict:
     """Surface the flagship distill QUALITY measurement (the reference's
     acc1 77.1->79.0 story) from the newest committed artifact —
@@ -915,6 +1098,8 @@ def main() -> None:
     hybrid = bench_hybrid_mesh(on_tpu)
     distill = bench_distill(on_tpu)
     churn = bench_distill_churn(on_tpu)
+    ckpt = bench_checkpoint(on_tpu)
+    downtime = bench_elastic_downtime(on_tpu)
     cores_to_feed = (resnet["imgs_per_sec"]
                      / max(loader["imgs_per_sec_per_core"], 1e-9))
     print(json.dumps({
@@ -1012,6 +1197,14 @@ def main() -> None:
             "distill_churn_kill_to_rejoin_s": churn["kill_to_rejoin_s"],
             "distill_churn_post_rejoin_imgs_per_sec":
                 churn["post_rejoin_imgs_per_sec"],
+            # checkpoint plane: step-loop stall per save, sync (the old
+            # epoch-end path, same artifact as the baseline clause asks)
+            # vs async snapshot-then-write, + write/restore wall time
+            # and the bitwise sync==async payload check
+            **ckpt,
+            # elastic stop-resume downtime: SIGKILL a trainer mid-run,
+            # respawn, clock kill -> first post-restore step
+            **downtime,
             # flagship distill QUALITY (committed artifact; see
             # tools/distill_quality_tpu.py)
             **distill_quality_extras(),

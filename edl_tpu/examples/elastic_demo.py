@@ -13,6 +13,7 @@ the same TrainLoop drives pjit models on real TPU meshes).
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 import jax
@@ -25,6 +26,7 @@ from edl_tpu.models.linear import LinearRegression, mse_loss
 from edl_tpu.train.loop import LoopConfig, TrainLoop
 from edl_tpu.train.state import TrainState
 from edl_tpu.train.step import make_train_step
+from edl_tpu.utils.config import from_env
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.examples.elastic_demo")
@@ -53,6 +55,12 @@ def main(argv=None) -> int:
     parser.add_argument("--batch", type=int, default=32)
     parser.add_argument("--step-time", type=float, default=0.0,
                         help="artificial per-step delay (resize-window test)")
+    parser.add_argument("--ckpt-steps", type=int, default=None,
+                        help="also checkpoint every N steps (default "
+                             "$EDL_TPU_CKPT_STEPS, else epoch-end only)")
+    parser.add_argument("--ckpt-sync", action="store_true",
+                        help="synchronous saves (default async "
+                             "snapshot-then-write)")
     args = parser.parse_args(argv)
 
     env = TrainerEnv.from_environ()
@@ -77,10 +85,15 @@ def main(argv=None) -> int:
             time.sleep(args.step_time)
             return raw_step(s, b)
 
-    loop = TrainLoop(step, state, config=LoopConfig(
-        num_epochs=args.epochs,
+    ckpt_kw = {}
+    if args.ckpt_steps is not None:
+        ckpt_kw["ckpt_every_steps"] = args.ckpt_steps
+    if args.ckpt_sync:
+        ckpt_kw["ckpt_async"] = False
+    loop = TrainLoop(step, state, config=from_env(
+        LoopConfig, num_epochs=args.epochs,
         ckpt_dir=env.checkpoint_path or None,
-        log_every_steps=args.steps_per_epoch))
+        log_every_steps=args.steps_per_epoch, **ckpt_kw))
     status = loop.run(lambda epoch: make_data(
         epoch, env.rank, env.world_size, args.steps_per_epoch, args.batch))
 
@@ -88,6 +101,8 @@ def main(argv=None) -> int:
     b = float(np.asarray(loop.state.params["Dense_0"]["bias"])[0])
     log.info("done: epoch=%d step=%d w=%.3f b=%.3f", status.epoch,
              status.step, w, b)
+    # machine-readable for the elastic-downtime bench (bench.py)
+    print("ckpt_stats=" + json.dumps(loop.ckpt_stats()), flush=True)
     return 0
 
 

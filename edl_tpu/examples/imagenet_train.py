@@ -207,6 +207,15 @@ def main(argv=None) -> int:
                         help="teacher fetch name (teacher_server "
                              "--output-key)")
     parser.add_argument("--ckpt-dir", default="")
+    parser.add_argument("--ckpt-steps", type=int, default=None,
+                        help="also checkpoint every N optimizer steps "
+                             "(cheap under async saves; shrinks the "
+                             "elastic replay window; default "
+                             "$EDL_TPU_CKPT_STEPS, else epoch-end only)")
+    parser.add_argument("--ckpt-sync", action="store_true",
+                        help="synchronous saves (escape hatch; default "
+                             "is async snapshot-then-write — the step "
+                             "loop blocks only for the host snapshot)")
     parser.add_argument("--benchmark-log", default="")
     parser.add_argument("--profile", default="",
                         help="jax profiler trace dir; traces steps "
@@ -260,10 +269,15 @@ def main(argv=None) -> int:
                          f"world {world}")
     local_bs = args.batch_size // world
 
+    ckpt_kw = {}
+    if args.ckpt_steps is not None:
+        ckpt_kw["ckpt_every_steps"] = args.ckpt_steps
+    if args.ckpt_sync:
+        ckpt_kw["ckpt_async"] = False
     loop_cfg = from_env(LoopConfig, num_epochs=args.epochs,
                         ckpt_dir=args.ckpt_dir or env.checkpoint_path
                         or None,
-                        profile_dir=args.profile or None)
+                        profile_dir=args.profile or None, **ckpt_kw)
     # --loader-workers wins when given; otherwise the LoopConfig (its
     # EDL_TPU_LOADER_WORKERS binding) sets the mp pool width, so the
     # loop config actually drives the input plane it runs on.
@@ -454,6 +468,7 @@ def main(argv=None) -> int:
         # close on the deadman/error path too (discovery client thread)
         if distill_reader is not None:
             distill_reader.close()
+    blog.extra(**loop.ckpt_stats())  # save-stall / restore accounting
     if rank == 0 and args.benchmark_log:
         blog.write(args.benchmark_log, rank)
     final = blog.finalize().get("final", {})

@@ -102,6 +102,13 @@ def main(argv=None) -> int:
                         help=argparse.SUPPRESS)  # legacy alias of --mesh fsdp
     parser.add_argument("--ckpt-dir", default="")
     parser.add_argument("--ckpt-sharded", action="store_true")
+    parser.add_argument("--ckpt-steps", type=int, default=None,
+                        help="also checkpoint every N optimizer steps "
+                             "(cheap under async saves; default "
+                             "$EDL_TPU_CKPT_STEPS, else epoch-end only)")
+    parser.add_argument("--ckpt-sync", action="store_true",
+                        help="synchronous saves (escape hatch; default "
+                             "async snapshot-then-write)")
     parser.add_argument("--benchmark-log", default="")
     parser.add_argument("--profile", default="",
                         help="jax profiler trace dir (steps 10-15, rank 0)")
@@ -137,10 +144,15 @@ def main(argv=None) -> int:
         raise SystemExit("global batch not divisible by world")
     local_bs = args.batch_size // world
 
+    ckpt_kw = {}
+    if args.ckpt_steps is not None:
+        ckpt_kw["ckpt_every_steps"] = args.ckpt_steps
+    if args.ckpt_sync:
+        ckpt_kw["ckpt_async"] = False
     loop_cfg = from_env(LoopConfig, num_epochs=args.epochs,
                         ckpt_dir=args.ckpt_dir or env.checkpoint_path
                         or None, ckpt_sharded=args.ckpt_sharded,
-                        profile_dir=args.profile or None)
+                        profile_dir=args.profile or None, **ckpt_kw)
     # --loader-workers wins when given; otherwise the LoopConfig (its
     # EDL_TPU_LOADER_WORKERS binding) sets the mp pool width.
     loader_workers = (args.loader_workers
@@ -255,6 +267,7 @@ def main(argv=None) -> int:
 
     data_fn.close = loader.close  # TrainLoop tears down the mp workers
     status = loop.run(data_fn)
+    blog.extra(**loop.ckpt_stats())  # save-stall / restore accounting
     if rank == 0 and args.benchmark_log:
         blog.write(args.benchmark_log, rank)
     final = blog.finalize().get("final", {})

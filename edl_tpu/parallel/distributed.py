@@ -79,11 +79,43 @@ def _backends_initialized() -> bool:
         return False
 
 
+def enable_compilation_cache(cache_dir: str | None = None) -> bool:
+    """Point XLA's persistent compilation cache at ``cache_dir`` (default
+    ``$EDL_TPU_COMPILE_CACHE_DIR``; no-op when unset).
+
+    The elastic-downtime lever: a stop-resume re-formation re-jits every
+    program from scratch, and for a world whose shape (and therefore
+    compiled programs) did NOT change, that recompile dominates
+    kill->first-step time. With the cache on a persistent path, the
+    re-formed trainer loads the previous generation's executables
+    instead of rebuilding them. Thresholds drop to 0 so even quick
+    compiles persist — an elastic restart replays ALL of them at once.
+    """
+    cache_dir = cache_dir or os.environ.get("EDL_TPU_COMPILE_CACHE_DIR")
+    if not cache_dir:
+        return False
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                          ("jax_persistent_cache_min_entry_size_bytes", 0)):
+            try:
+                jax.config.update(knob, val)
+            except AttributeError:  # older jax: keep its default threshold
+                pass
+    except AttributeError:
+        log.warning("this jax has no persistent compilation cache — "
+                    "EDL_TPU_COMPILE_CACHE_DIR ignored")
+        return False
+    log.info("persistent XLA compilation cache at %s", cache_dir)
+    return True
+
+
 def init_from_env(env: TrainerEnv | None = None) -> TrainerEnv:
     """Join the multi-host world described by the EDL_TPU_* env (no-op for
     single-pod jobs or repeat calls). Returns the parsed TrainerEnv."""
     global _initialized
     env = env or TrainerEnv.from_environ()
+    enable_compilation_cache()  # re-formed worlds skip unchanged re-jits
     if env.world_size > 1 and not _initialized:
         force_platform_from_env()
         if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):

@@ -1,7 +1,7 @@
 from edl_tpu.train.state import TrainState, TrainStatus
 from edl_tpu.train.amp import DynamicLossScale
-from edl_tpu.train.checkpoint import CheckpointManager
+from edl_tpu.train.checkpoint import CheckpointManager, CheckpointWriteError
 from edl_tpu.train import lr
 
 __all__ = ["TrainState", "TrainStatus", "CheckpointManager",
-           "DynamicLossScale", "lr"]
+           "CheckpointWriteError", "DynamicLossScale", "lr"]

@@ -40,6 +40,11 @@ class BenchmarkLog:
             **extra,
         }
 
+    def extra(self, **kv: Any) -> None:
+        """Merge late top-level extras (e.g. the train loop's checkpoint
+        save-stall/restore accounting, known only after the run)."""
+        self.result.update({k: _scalar(v) for k, v in kv.items()})
+
     def epoch(self, epoch: int, examples_per_sec: float | None = None,
               **metrics: Any) -> None:
         entry = {"epoch": epoch, **{k: _scalar(v) for k, v in metrics.items()}}

@@ -23,6 +23,18 @@ Two state-payload formats behind one manager:
   different mesh shape/device count — without ever materializing a full
   replica on host. ``restore`` auto-detects which format a version holds,
   so an elastic restart can move between formats.
+
+Async snapshot-then-write (``save_async``, the CheckFreq/Check-N-Run
+recipe): the step loop blocks only for a device->host snapshot into a
+double-buffered staging arena; a single background writer thread then
+does serialization, chunk writes, the tmp->final seal, mirror upload and
+GC. The queue is bounded drop-to-latest — a NEW snapshot supersedes a
+queued unwritten one but never an in-flight write — so checkpoint
+frequency can rise without the writer ever falling unboundedly behind.
+``wait()``/``close()`` are the epoch-end/shutdown barriers; a failed
+background write surfaces as ``CheckpointWriteError`` on the NEXT
+save/wait/close call. Sync and async saves produce bitwise-identical
+checkpoint bytes.
 """
 
 from __future__ import annotations
@@ -32,16 +44,26 @@ import os
 import re
 import shutil
 import tempfile
+import threading
+import time
 from typing import Any
 
 import jax
+import numpy as np
 from flax import serialization
 
 from edl_tpu.train import sharded_checkpoint as sc
 from edl_tpu.train.state import TrainStatus
 from edl_tpu.utils.logging import get_logger
+from edl_tpu.utils.timeline import timeline
 
 log = get_logger("edl_tpu.train.checkpoint")
+
+
+class CheckpointWriteError(RuntimeError):
+    """A background checkpoint write failed. Raised on the save/wait/close
+    call AFTER the failure (``save_async`` returns before its write runs,
+    so the error surfaces at the next synchronization point)."""
 
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
 _INDEX_FILE_RE = re.compile(r"^index\.(\d+)\.json$")
@@ -81,6 +103,25 @@ class CheckpointManager:
         # replicated save folds the remote LATEST into its version choice
         # once per manager lifetime (single mirror writer — see save())
         self._remote_folded = False
+        # wall seconds of the last restore() (elastic downtime accounting)
+        self.last_restore_s: float | None = None
+        # -- async snapshot-then-write plane (save_async) ------------------
+        self._cond = threading.Condition()
+        self._pending: dict | None = None   # drop-to-latest slot (size 1)
+        self._inflight = False
+        self._writer: threading.Thread | None = None
+        self._closed = False
+        self._write_error: BaseException | None = None
+        # double-buffered host staging: retired snapshot arenas recycled
+        # by np.copyto instead of reallocating the full state per save
+        self._staging_free: list[list] = []
+        self._staging_key: tuple | None = None
+        self._async_fallback_logged = False
+        self._tl = timeline("ckpt")
+        self._stats = {"saves_async": 0, "saves_sync": 0, "superseded": 0,
+                       "writes": 0, "errors": 0,
+                       "snapshot_ms_last": 0.0, "save_stall_ms_total": 0.0,
+                       "write_s_last": 0.0, "write_s_total": 0.0}
 
     @property
     def process_index(self) -> int:
@@ -110,22 +151,42 @@ class CheckpointManager:
     # -- save --------------------------------------------------------------
 
     def save(self, state: Any, status: TrainStatus) -> int | None:
-        """Save a new checkpoint; returns its version (None on non-writers).
+        """Save a new checkpoint synchronously; returns its version (None
+        on non-writers). The step loop pays the full serialize+write here
+        — ``save_async`` is the cheap-per-step path.
 
         Replicated mode: rank 0 does everything. Sharded mode: every
         process writes its chunks into the same pending dir (all callers
         of the world must call save together), then rank 0 seals it with
         meta.json + atomic rename after a world barrier.
         """
-        if self.sharded:
-            return self._save_sharded(state, status)
-        if self.process_index != 0:
-            # Non-writers still accumulate sealed ckpt-N dirs locally via
-            # restore-time mirror fetches — prune them (sealed-only: no
-            # pending dirs exist in replicated mode, but keep symmetry
-            # with the sharded branch).
-            self._gc(sealed_only=True)
-            return None
+        # An async writer may still be writing an older snapshot; two
+        # concurrent writers would race the version choice — drain first
+        # (also surfaces a prior background failure on this save call).
+        self.wait()
+        t0 = time.perf_counter()
+        try:
+            if self.sharded:
+                return self._save_sharded(state, status)
+            if self.process_index != 0:
+                # Non-writers still accumulate sealed ckpt-N dirs locally
+                # via restore-time mirror fetches — prune them
+                # (sealed-only: no pending dirs exist in replicated mode,
+                # but keep symmetry with the sharded branch).
+                self._gc(sealed_only=True)
+                return None
+            host_state = jax.device_get(state)
+            return self._write_replicated(host_state, status)
+        finally:
+            with self._cond:
+                self._stats["saves_sync"] += 1
+                self._stats["save_stall_ms_total"] += (
+                    time.perf_counter() - t0) * 1e3
+
+    def _write_replicated(self, host_state: Any, status: TrainStatus) -> int:
+        """Serialize + write + seal a host-side state pytree (rank 0's
+        replicated format). Runs on the caller's thread for `save` and on
+        the background writer for `save_async` — identical bytes."""
         latest = self.latest_version()
         mirror_this = self.remote is not None
         folded_now = False
@@ -134,7 +195,6 @@ class CheckpointManager:
             mirror_this = folded_now
         version = 0 if latest is None else latest + 1
         os.makedirs(self.directory, exist_ok=True)
-        host_state = jax.device_get(state)
         tmp = tempfile.mkdtemp(prefix=".tmp-ckpt-", dir=self.directory)
         try:
             with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
@@ -209,7 +269,12 @@ class CheckpointManager:
                 np.int32(value)))
         return value
 
-    def _save_sharded(self, state: Any, status: TrainStatus) -> int | None:
+    def _save_sharded(self, state: Any, status: TrainStatus,
+                      snap: dict | None = None) -> int | None:
+        # `snap`: a pre-taken host snapshot (sharded_checkpoint.
+        # snapshot_shards) written in place of `state` — the async
+        # writer's path, single-process worlds only (the barriers below
+        # must run on the thread that owns the collective context).
         # All processes must agree on the version. A per-process
         # latest_version() listing diverges when local dirs are NOT
         # shared (only rank 0 ever seals locally, so other pods would
@@ -249,7 +314,8 @@ class CheckpointManager:
         failure: BaseException | None = None
         my_files: list[str] = []
         try:
-            my_files = sc.save_sharded(tmp, state)
+            my_files = (sc.write_snapshot(tmp, snap) if snap is not None
+                        else sc.save_sharded(tmp, state))
         except BaseException as exc:  # noqa: BLE001 — re-raised below
             failure = exc
             try:
@@ -405,6 +471,218 @@ class CheckpointManager:
                 path = os.path.join(self.directory, name)
                 shutil.rmtree(path, ignore_errors=True)
 
+    def gc_stale_tmp(self) -> None:
+        """Startup GC: remove torn ``.tmp-*`` dirs — partial saves from a
+        crashed/killed writer (chunks written, never sealed) and orphaned
+        refetch staging. The save-time ``_gc`` only runs on ranks that
+        write and only after a successful save, so a run that dies before
+        its first save leaks them forever. Call at (re)start — e.g.
+        ``TrainLoop.try_restore`` — when no save of the current
+        generation can be pending; NOT from passive readers (a teacher
+        polling a shared dir must not sweep the trainer's in-progress
+        pending dir)."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return
+        for name in names:
+            if name.startswith(".tmp-"):
+                path = os.path.join(self.directory, name)
+                log.info("startup GC: removing stale partial save %s", path)
+                shutil.rmtree(path, ignore_errors=True)
+
+    # -- async snapshot-then-write -----------------------------------------
+
+    def save_async(self, state: Any, status: TrainStatus) -> None:
+        """Queue a checkpoint: the caller blocks only for the
+        device->host snapshot copy; serialization, disk writes, the
+        tmp->final seal, mirror upload and GC all happen on the
+        background writer thread. Raises ``CheckpointWriteError`` here
+        if a PREVIOUS background write failed.
+
+        Drop-to-latest: if an earlier snapshot is still queued (writer
+        busy), it is superseded by this one — the in-flight write is
+        never aborted, so the newest sealed version only moves forward.
+        Multi-process sharded worlds fall back to the synchronous path
+        (its world barriers must run on the training thread).
+        """
+        self._raise_pending_error()
+        if self.sharded and jax.process_count() > 1:
+            if not self._async_fallback_logged:
+                log.info("save_async: multi-process sharded world — "
+                         "falling back to synchronous saves")
+                self._async_fallback_logged = True
+            self.save(state, status)
+            return
+        if not self.sharded and self.process_index != 0:
+            self._gc(sealed_only=True)
+            return
+        t0 = time.perf_counter()
+        with self._tl.span("snapshot"):
+            # Supersede BEFORE staging so the dropped snapshot's arena is
+            # recycled into this copy (true double buffering: at most one
+            # in-flight + one pending arena live).
+            with self._cond:
+                if self._pending is not None:
+                    old = self._pending
+                    self._pending = None
+                    self._stats["superseded"] += 1
+                    self._recycle_arena(old)
+            status = TrainStatus.from_dict(status.to_dict())  # isolate the
+            # snapshot from the loop's live, mutating status cursor
+            if self.sharded:
+                snap = sc.snapshot_shards(state)
+                names = [n for n, _ in snap["chunks"]]
+                staged, arena = self._stage([a for _, a in snap["chunks"]])
+                snap["chunks"] = list(zip(names, staged))
+                job = {"kind": "sharded", "snap": snap}
+            else:
+                leaves, treedef = jax.tree_util.tree_flatten(state)
+                staged, arena = self._stage(jax.device_get(leaves))
+                job = {"kind": "replicated",
+                       "tree": jax.tree_util.tree_unflatten(treedef, staged)}
+            job.update(status=status, arena=arena,
+                       arena_key=self._staging_key)
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("CheckpointManager is closed")
+            self._stats["saves_async"] += 1
+            self._stats["snapshot_ms_last"] = stall_ms
+            self._stats["save_stall_ms_total"] += stall_ms
+            self._pending = job
+            if self._writer is None:
+                self._writer = threading.Thread(
+                    target=self._writer_loop, name="edl-ckpt-writer",
+                    daemon=True)
+                self._writer.start()
+            self._cond.notify_all()
+
+    def _stage(self, arrays: list) -> tuple[list, list]:
+        """Copy fetched host arrays into a recycled snapshot arena.
+        Copying is mandatory even though `jax.device_get` already ran:
+        on the CPU backend the fetched array can be a zero-copy VIEW of
+        the live device buffer, which a donating train step overwrites
+        before the background write runs. Returns (staged, arena)."""
+        key = tuple((tuple(getattr(a, "shape", ())),
+                     str(getattr(a, "dtype", type(a).__name__)))
+                    for a in arrays)
+        with self._cond:
+            if key != self._staging_key:
+                # state structure changed (resize/reshard) — old arenas
+                # no longer fit
+                self._staging_free.clear()
+                self._staging_key = key
+            arena = self._staging_free.pop() if self._staging_free else None
+        staged, new_arena = [], []
+        for i, a in enumerate(arrays):
+            if isinstance(a, np.ndarray):
+                dst = arena[i] if arena is not None else np.empty_like(a)
+                np.copyto(dst, a)
+                staged.append(dst)
+                new_arena.append(dst)
+            else:  # python scalar leaf — immutable, no copy needed
+                staged.append(a)
+                new_arena.append(None)
+        return staged, new_arena
+
+    def _recycle_arena(self, job: dict) -> None:
+        # caller holds self._cond
+        if (job.get("arena") is not None
+                and job.get("arena_key") == self._staging_key
+                and len(self._staging_free) < 2):
+            self._staging_free.append(job["arena"])
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None:
+                    return  # closed and drained
+                job = self._pending
+                self._pending = None
+                self._inflight = True
+            try:
+                t0 = time.perf_counter()
+                with self._tl.span("write"):
+                    if job["kind"] == "sharded":
+                        self._save_sharded(None, job["status"],
+                                           snap=job["snap"])
+                    else:
+                        self._write_replicated(job["tree"], job["status"])
+                dt = time.perf_counter() - t0
+                with self._cond:
+                    self._stats["writes"] += 1
+                    self._stats["write_s_last"] = dt
+                    self._stats["write_s_total"] += dt
+            except BaseException as exc:  # noqa: BLE001 — surfaced on the
+                log.exception(            # next save/wait/close call
+                    "async checkpoint write failed")
+                with self._cond:
+                    self._write_error = exc
+                    self._stats["errors"] += 1
+            finally:
+                with self._cond:
+                    self._recycle_arena(job)
+                    self._inflight = False
+                    self._cond.notify_all()
+
+    def wait(self, timeout: float | None = None) -> None:
+        """Barrier: block until every queued snapshot is durably written
+        (the epoch-end sync point). Re-raises a background write failure
+        as ``CheckpointWriteError``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._pending is not None or self._inflight:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        "checkpoint writer did not drain in time")
+                self._cond.wait(remaining)
+        self._raise_pending_error()
+
+    def close(self, raise_errors: bool = True) -> None:
+        """Shutdown barrier: drain the queued snapshot (a valid snapshot
+        is never thrown away — crash paths still seal their last state)
+        and stop the writer thread. ``raise_errors=False`` is for
+        crash-path ``finally`` blocks where raising would mask the
+        original exception; failures are logged either way. The manager
+        is reusable after close (a later ``save_async`` restarts the
+        writer)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            writer = self._writer
+        if writer is not None:
+            writer.join()
+        with self._cond:
+            self._writer = None
+            self._closed = False
+        if raise_errors:
+            self._raise_pending_error()
+
+    def _raise_pending_error(self) -> None:
+        with self._cond:
+            exc, self._write_error = self._write_error, None
+        if exc is not None:
+            raise CheckpointWriteError(
+                "background checkpoint write failed") from exc
+
+    def stats(self) -> dict:
+        """Save-stall / write accounting. ``save_stall_ms_total`` is the
+        step-loop-visible time across BOTH paths: full save duration for
+        sync saves, snapshot-copy duration for async ones."""
+        with self._cond:
+            s = dict(self._stats)
+        saves = s["saves_async"] + s["saves_sync"]
+        s["save_stall_ms_mean"] = (s["save_stall_ms_total"] / saves
+                                   if saves else 0.0)
+        if self.last_restore_s is not None:
+            s["restore_s"] = self.last_restore_s
+        return s
+
     # -- load --------------------------------------------------------------
 
     def restore_raw(self, version: int | None = None
@@ -457,8 +735,12 @@ class CheckpointManager:
         Auto-detects the version's format. Sharded checkpoints re-place
         each leaf per ``target``'s shardings (so pass the new world's
         freshly built state — any mesh shape); replicated checkpoints
-        deserialize to host numpy in ``target``'s structure.
+        deserialize to host numpy in ``target``'s structure. Sharded
+        chunk regions are read through a per-file handle cache on a
+        thread pool (``EDL_TPU_CKPT_RESTORE_THREADS``) — restore wall
+        time is the elastic-downtime term this call owns.
         """
+        t_start = time.perf_counter()
         if version is None:
             version = self.latest_version()
             if self.remote is not None:
@@ -529,6 +811,7 @@ class CheckpointManager:
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
         status = TrainStatus.from_dict(meta["status"])
-        log.info("restored checkpoint %s (epoch=%d step=%d)", path,
-                 status.epoch, status.step)
+        self.last_restore_s = time.perf_counter() - t_start
+        log.info("restored checkpoint %s (epoch=%d step=%d) in %.3fs", path,
+                 status.epoch, status.step, self.last_restore_s)
         return state, status

@@ -33,8 +33,21 @@ class LoopConfig:
     log_every_steps: int = field(20, env="EDL_TPU_LOG_EVERY")
     ckpt_dir: str | None = field(None, env="EDL_TPU_CHECKPOINT_PATH")
     ckpt_every_epochs: int = field(1, env="EDL_TPU_SAVE_CHECKPOINT_INTER")
-    ckpt_every_steps: int = field(0, env="EDL_TPU_SAVE_CHECKPOINT_STEPS")
+    # Step-interval checkpointing — cheap under async saves, so elastic
+    # jobs can shrink their replay-after-reformation window to N steps.
+    ckpt_every_steps: int = field(0, env=("EDL_TPU_CKPT_STEPS",
+                                          "EDL_TPU_SAVE_CHECKPOINT_STEPS"))
     ckpt_max_to_keep: int = field(3, env="EDL_TPU_CHECKPOINT_KEEP")
+    # Async snapshot-then-write saves (checkpoint.save_async): the step
+    # loop blocks only for the device->host snapshot; serialization +
+    # disk + mirror ride a background writer. False = the synchronous
+    # escape hatch (every save is a full stall, bytes identical).
+    ckpt_async: bool = field(True, env="EDL_TPU_CKPT_ASYNC")
+    # Persistent XLA compilation-cache dir: a re-formed world whose
+    # programs didn't change skips recompiling them on restart
+    # (parallel/distributed.enable_compilation_cache).
+    compile_cache_dir: str | None = field(None,
+                                          env="EDL_TPU_COMPILE_CACHE_DIR")
     # Sharded (per-process chunk) checkpoints — required once params are
     # fsdp/tp-sharded; replicated msgpack is the small-model default.
     ckpt_sharded: bool = field(False, env="EDL_TPU_CHECKPOINT_SHARDED")
@@ -100,8 +113,18 @@ class TrainLoop:
                                        sharded=self.config.ckpt_sharded,
                                        remote=self.config.ckpt_remote)
                      if self.config.ckpt_dir else None)
+        if self.config.compile_cache_dir:
+            from edl_tpu.parallel.distributed import enable_compilation_cache
+            enable_compilation_cache(self.config.compile_cache_dir)
         self.last_metrics: dict = {}
         self._profiling = False
+        # Save-stall accounting (benchlog/timeline): step-loop-visible ms
+        # spent in _save calls — full write under sync, snapshot copy
+        # under async — plus the restore seconds of this run's resume.
+        self.ckpt_stall_ms_total = 0.0
+        self.ckpt_saves = 0
+        self.restore_s: float | None = None
+        self._first_step_done = False
         # World size recorded in the restored checkpoint, set by
         # try_restore(); None until a restore happens. Consumers use it to
         # rescale LR/batch after an elastic resize (lr.scale_for_world).
@@ -124,7 +147,12 @@ class TrainLoop:
     def try_restore(self) -> bool:
         if self.ckpt is None:
             return False
+        # Startup GC: torn .tmp-* partial saves from a crashed/killed
+        # writer are invisible to restore (never sealed) but leak disk
+        # forever otherwise — the trainer start path owns the sweep.
+        self.ckpt.gc_stale_tmp()
         restored = self.ckpt.restore(self.state)
+        self.restore_s = self.ckpt.last_restore_s
         if restored is None:
             return False
         self.state, self.status = restored
@@ -138,9 +166,37 @@ class TrainLoop:
                                   else jax.device_count())
         return True
 
-    def _save(self) -> None:
-        if self.ckpt is not None:
+    def _save(self, sync: bool | None = None) -> None:
+        """Checkpoint now. Async by default (config.ckpt_async): blocks
+        only for the snapshot copy; ``sync=True`` is the per-call escape
+        hatch that waits for the full write."""
+        if self.ckpt is None:
+            return
+        use_sync = (not self.config.ckpt_async) if sync is None else sync
+        t0 = time.perf_counter()
+        if use_sync:
             self.ckpt.save(self.state, self.status)
+        else:
+            self.ckpt.save_async(self.state, self.status)
+        self.ckpt_stall_ms_total += (time.perf_counter() - t0) * 1e3
+        self.ckpt_saves += 1
+
+    def ckpt_stats(self) -> dict:
+        """Checkpoint-plane accounting for benchlog extras: loop-side
+        stall totals + the manager's snapshot/write/supersede stats."""
+        out = {"ckpt_save_stall_ms_total": round(self.ckpt_stall_ms_total, 3),
+               "ckpt_save_stall_ms_mean": round(
+                   self.ckpt_stall_ms_total / self.ckpt_saves, 3)
+               if self.ckpt_saves else 0.0,
+               "ckpt_saves": self.ckpt_saves,
+               "ckpt_async": bool(self.config.ckpt_async)}
+        if self.restore_s is not None:
+            out["ckpt_restore_s"] = round(self.restore_s, 3)
+        if self.ckpt is not None:
+            out.update({f"ckpt_{k}": (round(v, 3)
+                                      if isinstance(v, float) else v)
+                        for k, v in self.ckpt.stats().items()})
+        return out
 
     # -- main loop ---------------------------------------------------------
 
@@ -179,11 +235,25 @@ class TrainLoop:
                 if self.eval_fn is not None:
                     results = self.eval_fn(self.state, epoch)
                     log.info("eval epoch %d: %s", epoch, _fmt(results))
+                if self.ckpt is not None:
+                    # Epoch-end barrier: the epoch's (async) save becomes
+                    # durable before the next epoch starts — its write
+                    # overlapped eval above — and a background write
+                    # failure surfaces here, not epochs later.
+                    self.ckpt.wait()
             if self._profiling:  # run shorter than the window: still flush
                 jax.profiler.stop_trace()
                 self._profiling = False
+            if self.ckpt_saves:
+                log.info("ckpt plane: %s", self.ckpt_stats())
             return self.status
         finally:
+            if self.ckpt is not None:
+                # Shutdown barrier: drain the pending snapshot (crash
+                # paths still seal their last state) without masking an
+                # in-flight exception; clean-path write errors already
+                # surfaced at the epoch-end wait() above.
+                self.ckpt.close(raise_errors=False)
             # Even on a crash or the already-complete early return, the
             # lease must be revoked so a dead trainer's utilization
             # record expires instead of being kept fresh forever.
@@ -267,6 +337,17 @@ class TrainLoop:
         for i, batch in it:
             self._profile_window()
             self.state, metrics = self.step_fn(self.state, batch)
+            if not self._first_step_done:
+                # Downtime-accounting marker: the first step of THIS run
+                # (post-restore, post-compile) has really executed — the
+                # elastic kill->resume bench keys on this line, so force
+                # the dispatch before stamping it.
+                jax.block_until_ready(self.state)
+                self._first_step_done = True
+                log.info("first-step-complete global_step=%d restore_s=%s",
+                         self.status.step + 1,
+                         "%.3f" % self.restore_s
+                         if self.restore_s is not None else "none")
             self.status.step += 1
             self.status.step_in_epoch = i + 1
             n = (batch_size_fn(batch) if batch_size_fn

@@ -10,6 +10,19 @@ fsdp x tp state saved on 8 devices re-places onto 4 (or 32) by the
 target's sharding rules. Chunk reads go through numpy memory-maps, so
 restore materializes per-target-shard regions, never the full array.
 
+Save splits into two halves so the async checkpoint plane
+(train/checkpoint.py `save_async`) can run them on different threads:
+``snapshot_shards`` pulls this process's unique chunks to host (the only
+part that must block the step loop), ``write_snapshot`` does the disk
+I/O. ``save_sharded`` composes them, so sync and async saves produce
+bitwise-identical files.
+
+Restore reads regions through a per-file handle cache (each chunk is
+np.load'ed once per restore, not once per intersecting region) and, when
+``threads > 1``, prefetches every region on a thread pool before
+assembly — elastic re-formation wants the restore off the downtime
+budget as much as the save off the step loop.
+
 Layout inside a checkpoint directory:
   leaf{i}-o{start}_{start}...npy   one file per unique array chunk
   index.{process}.json             that process's chunk table + leaf specs
@@ -24,6 +37,8 @@ import glob
 import json
 import os
 import re
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
 import jax
@@ -56,18 +71,20 @@ def _slices_to_offset_shape(index: tuple, shape: tuple[int, ...]
     return tuple(offset), tuple(size)
 
 
-def save_sharded(directory: str, state: Any) -> list[str]:
-    """Write this process's unique shards of `state` into `directory`.
+def snapshot_shards(state: Any) -> dict:
+    """Host snapshot of this process's unique shards of ``state``.
 
-    Every process of the world must call this with the same state; chunks
-    are deduplicated so each array region is written exactly once
-    world-wide (the writer is the shard with replica_id == 0). Returns
-    the basenames of the files THIS process wrote (its chunks + its index
-    file) — what a non-shared-FS mirror must upload from this host.
+    The device->host pull half of ``save_sharded`` — the only part that
+    must run on the training thread (and the only part whose duration
+    the step loop pays under async saves). Returns ``{"leaves": table,
+    "chunks": [(fname, array), ...]}`` where the arrays MAY alias device
+    buffers on the CPU backend (np.asarray of an aligned shard is
+    zero-copy) — a caller that defers the write past the next train step
+    must copy them first (checkpoint.py stages them into its snapshot
+    arena).
     """
-    os.makedirs(directory, exist_ok=True)
     leaves = jax.tree_util.tree_flatten_with_path(state)[0]
-    written: list[str] = []
+    chunks_out: list[tuple[str, np.ndarray]] = []
     table = []
     for i, (path, leaf) in enumerate(leaves):
         key = _leaf_key(path)
@@ -80,9 +97,7 @@ def save_sharded(directory: str, state: Any) -> list[str]:
                     continue
                 offset, size = _slices_to_offset_shape(shard.index, shape)
                 fname = _chunk_name(i, offset)
-                np.save(os.path.join(directory, fname),
-                        np.asarray(shard.data))
-                written.append(fname)
+                chunks_out.append((fname, np.asarray(shard.data)))
                 chunks.append({"offset": list(offset), "shape": list(size),
                                "file": fname})
         else:  # host scalar / numpy leaf — process 0 owns it whole
@@ -92,17 +107,45 @@ def save_sharded(directory: str, state: Any) -> list[str]:
             if jax.process_index() == 0:
                 offset = tuple(0 for _ in shape)
                 fname = _chunk_name(i, offset)
-                np.save(os.path.join(directory, fname), arr)
-                written.append(fname)
+                chunks_out.append((fname, arr))
                 chunks.append({"offset": list(offset),
                                "shape": list(arr.shape), "file": fname})
         table.append({"key": key, "shape": list(shape), "dtype": dtype,
                       "chunks": chunks})
-    index_name = f"index.{jax.process_index()}.json"
+    return {"leaves": table, "chunks": chunks_out,
+            "process_index": jax.process_index()}
+
+
+def write_snapshot(directory: str, snap: dict) -> list[str]:
+    """Write a ``snapshot_shards`` result into ``directory``.
+
+    The disk half of ``save_sharded`` — safe to run on a background
+    thread (pure numpy + file I/O, no device access). Returns the
+    basenames this process wrote (chunks + its index file), index last
+    so its presence implies the chunks made it.
+    """
+    os.makedirs(directory, exist_ok=True)
+    written: list[str] = []
+    for fname, arr in snap["chunks"]:
+        np.save(os.path.join(directory, fname), arr)
+        written.append(fname)
+    index_name = f"index.{snap['process_index']}.json"
     with open(os.path.join(directory, index_name), "w") as f:
-        json.dump({"leaves": table}, f)
+        json.dump({"leaves": snap["leaves"]}, f)
     written.append(index_name)
     return written
+
+
+def save_sharded(directory: str, state: Any) -> list[str]:
+    """Write this process's unique shards of `state` into `directory`.
+
+    Every process of the world must call this with the same state; chunks
+    are deduplicated so each array region is written exactly once
+    world-wide (the writer is the shard with replica_id == 0). Returns
+    the basenames of the files THIS process wrote (its chunks + its index
+    file) — what a non-shared-FS mirror must upload from this host.
+    """
+    return write_snapshot(directory, snapshot_shards(state))
 
 
 def _merged_index(directory: str) -> dict[str, dict]:
@@ -125,7 +168,34 @@ def _merged_index(directory: str) -> dict[str, dict]:
     return merged
 
 
-def _read_region(directory: str, entry: dict, index: tuple) -> np.ndarray:
+class _ChunkFiles:
+    """Per-restore cache of memory-mapped chunk files.
+
+    A resharding restore reads the same chunk for every target region it
+    intersects; re-running np.load per region paid a file open + header
+    parse each time. One handle per file, shared across regions (and
+    across reader threads — numpy memmap reads are thread-safe)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._handles: dict[str, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    def load(self, fname: str) -> np.ndarray:
+        with self._lock:
+            h = self._handles.get(fname)
+            if h is None:
+                h = np.load(os.path.join(self.directory, fname),
+                            mmap_mode="r")
+                self._handles[fname] = h
+            return h
+
+    def close(self) -> None:
+        self._handles.clear()  # memmaps close when the views are collected
+
+
+def _read_region(files: _ChunkFiles, entry: dict, index: tuple
+                 ) -> np.ndarray:
     """Assemble the region `index` (tuple of slices) from saved chunks."""
     shape = tuple(entry["shape"])
     offset, size = _slices_to_offset_shape(index, shape)
@@ -140,7 +210,7 @@ def _read_region(directory: str, entry: dict, index: tuple) -> np.ndarray:
               for o, s, co, cs in zip(offset, size, coff, cshape)]
         if any(a >= b for a, b in zip(lo, hi)):
             continue
-        src = np.load(os.path.join(directory, chunk["file"]), mmap_mode="r")
+        src = files.load(chunk["file"])
         src_sel = tuple(slice(a - co, b - co)
                         for a, b, co in zip(lo, hi, coff))
         dst_sel = tuple(slice(a - o, b - o)
@@ -156,7 +226,26 @@ def _read_region(directory: str, entry: dict, index: tuple) -> np.ndarray:
     return out
 
 
-def restore_sharded(directory: str, target: Any) -> Any:
+def restore_threads() -> int:
+    """Region-read pool width for restore (the restore-side half of the
+    elastic downtime budget). Env-tunable; defaults past 1 even on small
+    hosts because the reads are mmap-page-in bound, not CPU bound."""
+    env = os.environ.get("EDL_TPU_CKPT_RESTORE_THREADS", "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            log.warning("ignoring malformed EDL_TPU_CKPT_RESTORE_THREADS=%r",
+                        env)
+    return min(8, 2 * (os.cpu_count() or 1))
+
+
+def _region_key(index: tuple, shape: tuple[int, ...]) -> tuple:
+    return _slices_to_offset_shape(index, shape)
+
+
+def restore_sharded(directory: str, target: Any,
+                    threads: int | None = None) -> Any:
     """Re-place a sharded checkpoint onto `target`'s shardings.
 
     `target` is a pytree whose array leaves carry the DESTINATION sharding
@@ -164,10 +253,22 @@ def restore_sharded(directory: str, target: Any) -> Any:
     `sharding` set) — typically the freshly initialized state of the new
     world. Leaves are assembled chunk-wise per target shard, so a state
     saved on one mesh shape restores onto any other.
+
+    ``threads``: region-read pool width (default `restore_threads()`,
+    env ``EDL_TPU_CKPT_RESTORE_THREADS``); every unique target region is
+    prefetched concurrently before device placement, and 1 keeps the
+    serial path.
     """
     merged = _merged_index(directory)
+    files = _ChunkFiles(directory)
+    if threads is None:
+        threads = restore_threads()
     leaves, treedef = jax.tree_util.tree_flatten_with_path(target)
-    out = []
+
+    # Plan every unique region to read: one entry per (leaf, region) —
+    # a dp-replicated target asks for the same region once per replica,
+    # the cache below reads it once.
+    plans = []   # (key, entry, sharding|None, leaf, [region indexes])
     for path, leaf in leaves:
         key = _leaf_key(path)
         entry = merged.get(key)
@@ -186,16 +287,51 @@ def restore_sharded(directory: str, target: Any) -> Any:
                 raise ValueError(
                     f"{key}: target shape {tuple(leaf.shape)} != saved "
                     f"{shape}")
-            arr = jax.make_array_from_callback(
-                shape, sharding,
-                lambda idx, e=entry: _read_region(directory, e, idx))
+            try:
+                idx_map = sharding.addressable_devices_indices_map(shape)
+            except AttributeError:  # older jax: no prefetch plan — the
+                idx_map = {}        # callback reads on demand (cached)
+            uniq = {_region_key(idx, shape): idx for idx in idx_map.values()}
+            plans.append((key, entry, sharding, leaf, list(uniq.values())))
+        else:
+            plans.append((key, entry, None, leaf,
+                          [tuple(slice(0, s) for s in shape)]))
+
+    regions: dict[tuple, np.ndarray] = {}
+
+    def read(entry, idx):
+        k = (id(entry), _region_key(idx, tuple(entry["shape"])))
+        regions[k] = _read_region(files, entry, idx)
+
+    jobs = [(entry, idx) for _, entry, _, _, idxs in plans for idx in idxs]
+    if threads > 1 and len(jobs) > 1:
+        with ThreadPoolExecutor(max_workers=threads,
+                                thread_name_prefix="edl-ckpt-read") as pool:
+            # list() re-raises the first read error (coverage holes must
+            # fail the restore loudly, threaded or not)
+            list(pool.map(lambda j: read(*j), jobs))
+    else:
+        for j in jobs:
+            read(*j)
+
+    out = []
+    for key, entry, sharding, leaf, idxs in plans:
+        shape = tuple(entry["shape"])
+        if sharding is not None:
+            def region(idx, e=entry):
+                k = (id(e), _region_key(idx, tuple(e["shape"])))
+                if k not in regions:  # older-jax fallback: no prefetch plan
+                    regions[k] = _read_region(files, e, idx)
+                return regions[k]
+
+            arr = jax.make_array_from_callback(shape, sharding, region)
             # preserve weak_type of scalars created by jit (e.g. step)
             out.append(arr.astype(leaf.dtype) if arr.dtype != leaf.dtype
                        else arr)
         else:
-            full = _read_region(directory, entry,
-                                tuple(slice(0, s) for s in shape))
+            full = regions[(id(entry), _region_key(idxs[0], shape))]
             out.append(full if shape else full[()])
+    files.close()
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

@@ -19,8 +19,10 @@ from typing import Any, TypeVar
 T = TypeVar("T")
 
 
-def field(default: Any = dataclasses.MISSING, *, env: str | None = None, **kw):
-    """Dataclass field that can be overridden by the env var ``env``."""
+def field(default: Any = dataclasses.MISSING, *,
+          env: str | tuple[str, ...] | None = None, **kw):
+    """Dataclass field that can be overridden by the env var ``env`` (a
+    tuple names aliases — first one set wins)."""
     metadata = dict(kw.pop("metadata", {}))
     if env is not None:
         metadata["env"] = env
@@ -53,8 +55,12 @@ def from_env(cls: type[T], **overrides: Any) -> T:
     kwargs: dict[str, Any] = {}
     for f in dataclasses.fields(cls):
         env_name = f.metadata.get("env")
-        if env_name and env_name in os.environ:
-            kwargs[f.name] = _parse(os.environ[env_name], hints.get(f.name, str))
+        names = (env_name,) if isinstance(env_name, str) else (env_name or ())
+        for name in names:
+            if name in os.environ:
+                kwargs[f.name] = _parse(os.environ[name],
+                                        hints.get(f.name, str))
+                break
     kwargs.update(overrides)
     return cls(**kwargs)
 

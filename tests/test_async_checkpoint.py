@@ -1,0 +1,397 @@
+"""Async snapshot-then-write checkpointing: semantics and atomicity.
+
+The contracts the elastic story leans on (CheckFreq/Check-N-Run recipe,
+train/checkpoint.py `save_async`):
+
+- snapshot isolation: the checkpoint holds the state AS OF the save
+  call, however the live state mutates before the write runs;
+- drop-to-latest: a queued unwritten snapshot is superseded by a newer
+  one; an in-flight write is never aborted;
+- wait()/close() barriers drain the writer; a background write failure
+  surfaces on the NEXT save/wait call, and the manager recovers;
+- sync and async saves produce bitwise-identical checkpoint bytes
+  (replicated msgpack AND sharded chunk files);
+- crash-mid-save atomicity: a writer killed between chunk writes and the
+  seal leaves a torn .tmp dir that restore never sees and startup GC
+  removes.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu.parallel import mesh as mesh_lib, sharding as shd
+from edl_tpu.train import sharded_checkpoint as sc
+from edl_tpu.train.checkpoint import (CheckpointManager,
+                                      CheckpointWriteError)
+from edl_tpu.train.state import TrainState, TrainStatus
+
+
+def _state(value: float) -> TrainState:
+    params = {"w": jnp.full((4,), value), "b": jnp.zeros((2, 2))}
+    return TrainState.create(apply_fn=lambda *a: None, params=params,
+                             tx=optax.sgd(0.1))
+
+
+def _w(state) -> float:
+    return float(np.asarray(state.params["w"])[0])
+
+
+# -- async semantics ---------------------------------------------------------
+
+
+def test_async_roundtrip_and_wait_barrier(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), process_index=0)
+    mgr.save_async(_state(1.5), TrainStatus(epoch=3, step=30))
+    mgr.wait()
+    # after the barrier the version is sealed and visible
+    assert mgr.versions() == [0]
+    restored, status = mgr.restore(_state(0.0))
+    assert _w(restored) == 1.5
+    assert status.epoch == 3 and status.step == 30
+    mgr.close()
+
+
+def test_snapshot_isolation_from_live_state_and_status(tmp_path):
+    """The write happens later — it must capture save-call-time values,
+    not whatever the training loop mutated them into since."""
+    mgr = CheckpointManager(str(tmp_path), process_index=0)
+    gate = threading.Event()
+    real_write = mgr._write_replicated
+
+    def gated_write(host_state, status):
+        gate.wait(10.0)
+        return real_write(host_state, status)
+
+    mgr._write_replicated = gated_write
+    state = _state(7.0)
+    status = TrainStatus(epoch=1, step=10)
+    mgr.save_async(state, status)
+    # mutate the live objects while the write is still pending
+    status.step = 999
+    status.epoch = 42
+    state = None  # the loop would donate/overwrite the buffers
+    gate.set()
+    mgr.wait()
+    restored, got = mgr.restore(_state(0.0))
+    assert _w(restored) == 7.0
+    assert got.step == 10 and got.epoch == 1
+    mgr.close()
+
+
+def test_drop_to_latest_supersede_never_inflight(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), process_index=0)
+    started = threading.Event()
+    gate = threading.Event()
+    real_write = mgr._write_replicated
+
+    def gated_write(host_state, status):
+        started.set()
+        gate.wait(10.0)
+        return real_write(host_state, status)
+
+    mgr._write_replicated = gated_write
+    mgr.save_async(_state(1.0), TrainStatus(step=1))
+    assert started.wait(10.0)  # save #1 is IN FLIGHT (never aborted)
+    mgr.save_async(_state(2.0), TrainStatus(step=2))  # queued ...
+    mgr.save_async(_state(3.0), TrainStatus(step=3))  # ... superseded by #3
+    gate.set()
+    mgr.wait()
+    # exactly two versions: the in-flight #1 and the latest #3; #2 died
+    assert mgr.versions() == [0, 1]
+    assert mgr.stats()["superseded"] == 1
+    r1, s1 = mgr.restore(_state(0.0), version=0)
+    r3, s3 = mgr.restore(_state(0.0), version=1)
+    assert _w(r1) == 1.0 and s1.step == 1
+    assert _w(r3) == 3.0 and s3.step == 3
+    mgr.close()
+
+
+def test_writer_error_surfaces_on_next_save_then_recovers(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), process_index=0)
+    real_write = mgr._write_replicated
+    boom = RuntimeError("disk on fire")
+
+    def failing_write(host_state, status):
+        raise boom
+
+    mgr._write_replicated = failing_write
+    mgr.save_async(_state(1.0), TrainStatus(step=1))  # enqueues fine
+    # drain without raising (close(raise_errors=False) is the crash path)
+    mgr.close(raise_errors=False)
+    with pytest.raises(CheckpointWriteError) as exc_info:
+        mgr.save_async(_state(2.0), TrainStatus(step=2))
+    assert exc_info.value.__cause__ is boom
+    # the error was consumed; the manager keeps working afterwards
+    mgr._write_replicated = real_write
+    mgr.save_async(_state(3.0), TrainStatus(step=3))
+    mgr.wait()
+    restored, status = mgr.restore(_state(0.0))
+    assert _w(restored) == 3.0 and status.step == 3
+    mgr.close()
+
+
+def test_wait_raises_writer_error(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), process_index=0)
+    mgr._write_replicated = lambda *a: (_ for _ in ()).throw(
+        RuntimeError("boom"))
+    mgr.save_async(_state(1.0), TrainStatus(step=1))
+    with pytest.raises(CheckpointWriteError):
+        mgr.wait()
+    mgr.close()
+
+
+def test_nonzero_rank_save_async_is_noop(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), process_index=1)
+    mgr.save_async(_state(1.0), TrainStatus(step=1))
+    mgr.wait()
+    mgr.close()
+    assert mgr.versions() == []
+
+
+# -- bitwise identity --------------------------------------------------------
+
+
+def test_sync_async_bitwise_identical_replicated(tmp_path):
+    state, status = _state(4.25), TrainStatus(epoch=2, step=20, world_size=8)
+    sync_mgr = CheckpointManager(str(tmp_path / "sync"), process_index=0)
+    sync_mgr.save(state, status)
+    async_mgr = CheckpointManager(str(tmp_path / "async"), process_index=0)
+    async_mgr.save_async(state, status)
+    async_mgr.close()
+    for name in ("state.msgpack", "meta.json"):
+        a = (tmp_path / "sync" / "ckpt-0" / name).read_bytes()
+        b = (tmp_path / "async" / "ckpt-0" / name).read_bytes()
+        assert a == b, f"{name} differs between sync and async saves"
+
+
+def _sharded_state(mesh):
+    from edl_tpu.models.transformer import Transformer, TransformerConfig
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_len=64,
+                            dtype=jnp.float32, mesh=mesh)
+    model = Transformer(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
+    variables = shd.init_sharded(
+        lambda: model.init(jax.random.PRNGKey(0), toks, train=False), mesh)
+    return TrainState.create(apply_fn=model.apply,
+                             params=variables["params"],
+                             tx=optax.adamw(1e-3))
+
+
+def test_sync_async_bitwise_identical_sharded(tmp_path):
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"fsdp": 2, "tp": 2}),
+                              n_devices=4)
+    state = _sharded_state(mesh)
+    status = TrainStatus(epoch=1, step=5)
+    sync_mgr = CheckpointManager(str(tmp_path / "sync"), sharded=True)
+    sync_mgr.save(state, status)
+    async_mgr = CheckpointManager(str(tmp_path / "async"), sharded=True)
+    async_mgr.save_async(state, status)
+    async_mgr.close()
+    sdir, adir = tmp_path / "sync" / "ckpt-0", tmp_path / "async" / "ckpt-0"
+    names = sorted(os.listdir(sdir))
+    assert names == sorted(os.listdir(adir))
+    for name in names:
+        assert (sdir / name).read_bytes() == (adir / name).read_bytes(), \
+            f"{name} differs between sync and async sharded saves"
+
+
+def test_async_sharded_roundtrip_onto_other_mesh(tmp_path):
+    big = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": 2, "fsdp": 2,
+                                                "tp": 2}))
+    state = _sharded_state(big)
+    mgr = CheckpointManager(str(tmp_path), sharded=True)
+    mgr.save_async(state, TrainStatus(epoch=0, step=1))
+    mgr.wait()
+    small = mesh_lib.make_mesh(mesh_lib.MeshSpec({"fsdp": 2, "tp": 2}),
+                               n_devices=4)
+    fresh = _sharded_state(small)
+    restored, status = mgr.restore(fresh)
+    assert status.step == 1
+    for a, b in zip(jax.tree.leaves(jax.device_get(state)),
+                    jax.tree.leaves(jax.device_get(restored))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+# -- crash-mid-save atomicity + startup GC -----------------------------------
+
+
+def test_crash_between_chunks_and_seal_falls_back_and_gcs(tmp_path):
+    """Kill the writer after the chunk writes but before the seal: the
+    torn .tmp dir must never be visible to restore (previous sealed
+    version wins) and must be GC'd at the next start."""
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"fsdp": 2, "tp": 2}),
+                              n_devices=4)
+    state = _sharded_state(mesh)
+    mgr = CheckpointManager(str(tmp_path), sharded=True)
+    assert mgr.save(state, TrainStatus(epoch=0, step=10)) == 0
+
+    # the "crash": chunks + index of version 1 land in the pending dir,
+    # but the writer dies before meta.json + the atomic rename
+    torn = tmp_path / ".tmp-ckpt-1"
+    sc.save_sharded(str(torn), state)
+    assert torn.is_dir() and not (torn / "meta.json").exists()
+
+    # a re-formed world restores the previous SEALED version
+    mgr2 = CheckpointManager(str(tmp_path), sharded=True)
+    assert mgr2.latest_version() == 0
+    restored, status = mgr2.restore(_sharded_state(mesh))
+    assert status.step == 10
+
+    # ... and startup GC (the TrainLoop.try_restore path) removes the
+    # torn dir instead of leaking it forever
+    mgr2.gc_stale_tmp()
+    assert not torn.exists()
+    assert mgr2.versions() == [0]
+
+
+def test_train_loop_startup_gcs_torn_tmp(tmp_path):
+    """The trainer start path itself sweeps torn partial saves."""
+    from edl_tpu.examples import fit_a_line
+    from edl_tpu.parallel.mesh import make_mesh
+    from edl_tpu.train.loop import LoopConfig, TrainLoop
+
+    for name in (".tmp-ckpt-7", ".tmp-refetch-x"):
+        (tmp_path / name).mkdir()
+        (tmp_path / name / "leaf0-o0.npy").write_bytes(b"torn")
+    cfg = fit_a_line.Config(num_epochs=1, steps_per_epoch=3)
+    state, step_fn = fit_a_line.build(cfg)
+    loop = TrainLoop(step_fn, state, mesh=make_mesh(),
+                     config=LoopConfig(num_epochs=1, ckpt_dir=str(tmp_path),
+                                       log_every_steps=1000))
+    loop.run(lambda e: fit_a_line.synthetic_batches(e, cfg))
+    assert not any(n.startswith(".tmp-") for n in os.listdir(tmp_path))
+    assert loop.status.epoch == 0  # and training completed
+
+
+# -- restore: parallel region reads + one open per chunk ---------------------
+
+
+def test_restore_parallel_matches_serial(tmp_path):
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": 2, "fsdp": 2,
+                                                 "tp": 2}))
+    state = _sharded_state(mesh)
+    sc.save_sharded(str(tmp_path / "s"), state)
+    fresh = _sharded_state(mesh)
+    serial = sc.restore_sharded(str(tmp_path / "s"), fresh, threads=1)
+    parallel = sc.restore_sharded(str(tmp_path / "s"), fresh, threads=4)
+    for a, b in zip(jax.tree.leaves(jax.device_get(serial)),
+                    jax.tree.leaves(jax.device_get(parallel))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_opens_each_chunk_once(tmp_path, monkeypatch):
+    """A resharding restore intersects each chunk with many target
+    regions; the handle cache must np.load each file once, not once per
+    region."""
+    small = mesh_lib.make_mesh(mesh_lib.MeshSpec({"fsdp": 2, "tp": 2}),
+                               n_devices=4)
+    state = _sharded_state(small)
+    sc.save_sharded(str(tmp_path / "s"), state)
+
+    opens: dict[str, int] = {}
+    real_load = np.load
+
+    def counting_load(path, *a, **kw):
+        opens[os.path.basename(str(path))] = \
+            opens.get(os.path.basename(str(path)), 0) + 1
+        return real_load(path, *a, **kw)
+
+    monkeypatch.setattr(sc.np, "load", counting_load)
+    # 4 -> 8 devices: every saved chunk feeds multiple target shards
+    big = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": 2, "fsdp": 2,
+                                                "tp": 2}))
+    sc.restore_sharded(str(tmp_path / "s"), _sharded_state(big))
+    assert opens, "no chunk reads recorded"
+    multi = [n for n, c in opens.items() if c > 1]
+    assert not multi, f"chunks re-opened per region: {multi}"
+
+
+def test_restore_threads_env_knob(monkeypatch):
+    monkeypatch.setenv("EDL_TPU_CKPT_RESTORE_THREADS", "3")
+    assert sc.restore_threads() == 3
+    monkeypatch.setenv("EDL_TPU_CKPT_RESTORE_THREADS", "bogus")
+    assert sc.restore_threads() >= 1
+    monkeypatch.delenv("EDL_TPU_CKPT_RESTORE_THREADS")
+    assert sc.restore_threads() >= 1
+
+
+# -- TrainLoop integration ---------------------------------------------------
+
+
+def test_loop_async_saves_match_sync_saves(tmp_path):
+    """ckpt_async must not change WHAT gets checkpointed — final
+    checkpoint bytes of an async run equal the sync run's."""
+    from edl_tpu.examples import fit_a_line
+    from edl_tpu.parallel.mesh import make_mesh
+    from edl_tpu.train.loop import LoopConfig, TrainLoop
+
+    def run(subdir, ckpt_async):
+        cfg = fit_a_line.Config(num_epochs=2, steps_per_epoch=6)
+        state, step_fn = fit_a_line.build(cfg)
+        loop = TrainLoop(step_fn, state, mesh=make_mesh(),
+                         config=LoopConfig(num_epochs=2,
+                                           ckpt_dir=str(tmp_path / subdir),
+                                           ckpt_every_steps=4,
+                                           ckpt_async=ckpt_async,
+                                           log_every_steps=1000))
+        loop.run(lambda e: fit_a_line.synthetic_batches(e, cfg))
+        return loop
+
+    sync_loop, async_loop = run("sync", False), run("async", True)
+    assert async_loop.ckpt_saves == sync_loop.ckpt_saves
+    stats = async_loop.ckpt_stats()
+    assert stats["ckpt_saves_async"] > 0 and stats["ckpt_errors"] == 0
+    sync_versions = sorted(os.listdir(tmp_path / "sync"))
+    assert sorted(os.listdir(tmp_path / "async")) == sync_versions
+    last = sync_versions[-1]
+    assert (tmp_path / "sync" / last / "state.msgpack").read_bytes() == \
+        (tmp_path / "async" / last / "state.msgpack").read_bytes()
+
+
+def test_loop_surfaces_writer_failure(tmp_path):
+    """A background write failure must fail the RUN (at the epoch-end
+    wait barrier), not vanish into a daemon thread."""
+    from edl_tpu.examples import fit_a_line
+    from edl_tpu.parallel.mesh import make_mesh
+    from edl_tpu.train.loop import LoopConfig, TrainLoop
+
+    cfg = fit_a_line.Config(num_epochs=1, steps_per_epoch=4)
+    state, step_fn = fit_a_line.build(cfg)
+    loop = TrainLoop(step_fn, state, mesh=make_mesh(),
+                     config=LoopConfig(num_epochs=1,
+                                       ckpt_dir=str(tmp_path / "ck"),
+                                       log_every_steps=1000))
+    loop.ckpt._write_replicated = lambda *a: (_ for _ in ()).throw(
+        OSError("no space left on device"))
+    with pytest.raises(CheckpointWriteError):
+        loop.run(lambda e: fit_a_line.synthetic_batches(e, cfg))
+
+
+def test_status_json_matches_sync_semantics(tmp_path):
+    """meta.json of an async mid-epoch save records the cursor AS OF the
+    save step (the resume contract), not the end-of-run cursor."""
+    from edl_tpu.examples import fit_a_line
+    from edl_tpu.parallel.mesh import make_mesh
+    from edl_tpu.train.loop import LoopConfig, TrainLoop
+
+    cfg = fit_a_line.Config(num_epochs=1, steps_per_epoch=10)
+    state, step_fn = fit_a_line.build(cfg)
+    loop = TrainLoop(step_fn, state, mesh=make_mesh(),
+                     config=LoopConfig(num_epochs=1,
+                                       ckpt_dir=str(tmp_path),
+                                       ckpt_every_steps=4,
+                                       log_every_steps=1000))
+    loop.run(lambda e: fit_a_line.synthetic_batches(e, cfg))
+    with open(tmp_path / "ckpt-0" / "meta.json") as f:
+        meta = json.load(f)
+    assert meta["status"]["step"] == 4
+    assert meta["status"]["step_in_epoch"] == 4

@@ -1,0 +1,117 @@
+"""One-shot checkpoint-plane tuner: save stall across state sizes x
+sync/async x save interval.
+
+Sizing companion to the async snapshot-then-write plane
+(edl_tpu/train/checkpoint.py `save_async`): for each state size it runs
+a simulated step loop (fixed per-step compute) that checkpoints every N
+steps, and reports what the STEP LOOP paid per save — the full
+serialize+write under sync, the snapshot copy under async — plus the
+background write time and how many queued snapshots the drop-to-latest
+rule superseded. Picking `--ckpt-steps` / EDL_TPU_CKPT_STEPS for a job
+becomes one command: walk the interval down until the stall column (or
+the superseded column — the writer's sign that it can't keep up) says
+stop.
+
+  python tools/ckpt_bench.py --sizes-mb 4 16 64 --intervals 1 5 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/ckpt_bench.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
+
+
+def build_state(size_mb: float):
+    """A train-state-shaped pytree of the requested footprint: a few
+    dozen layer-ish leaves (serialization cost scales with leaf count
+    too, not just bytes) placed on device."""
+    import jax
+    import numpy as np
+
+    n_leaves = 32
+    floats = int(size_mb * 2**20 / 4)
+    per_leaf = max(1, floats // n_leaves)
+    side = max(1, int(per_leaf ** 0.5))
+    rng = np.random.default_rng(0)
+    tree = {"params": {f"layer_{i}": {
+        "kernel": rng.normal(size=(side, side)).astype(np.float32),
+        "bias": rng.normal(size=(side,)).astype(np.float32)}
+        for i in range(n_leaves)}}
+    return jax.device_put(tree)
+
+
+def run_case(state, *, sync: bool, interval: int, steps: int,
+             step_s: float) -> dict:
+    from edl_tpu.train.checkpoint import CheckpointManager
+    from edl_tpu.train.state import TrainStatus
+
+    d = tempfile.mkdtemp(prefix="edl-ckpt-bench-")
+    mgr = CheckpointManager(d, max_to_keep=2, process_index=0)
+    stall_ms = []
+    t_run = time.perf_counter()
+    try:
+        for step in range(1, steps + 1):
+            time.sleep(step_s)  # the "train step" (releases the GIL,
+            # like device compute — the writer thread overlaps it)
+            if step % interval == 0:
+                t0 = time.perf_counter()
+                if sync:
+                    mgr.save(state, TrainStatus(step=step))
+                else:
+                    mgr.save_async(state, TrainStatus(step=step))
+                stall_ms.append((time.perf_counter() - t0) * 1e3)
+        mgr.close()
+        run_s = time.perf_counter() - t_run
+        stats = mgr.stats()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    stall_ms.sort()
+    return {"stall_ms": stall_ms[len(stall_ms) // 2],
+            "stall_ms_max": stall_ms[-1],
+            "write_s": stats["write_s_last"],
+            "superseded": stats["superseded"],
+            "run_s": run_s}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tools/ckpt_bench.py")
+    parser.add_argument("--sizes-mb", type=float, nargs="+",
+                        default=[4, 16, 64])
+    parser.add_argument("--intervals", type=int, nargs="+",
+                        default=[1, 5, 20],
+                        help="checkpoint every N steps")
+    parser.add_argument("--steps", type=int, default=40,
+                        help="simulated steps per case")
+    parser.add_argument("--step-ms", type=float, default=20.0,
+                        help="simulated per-step compute")
+    args = parser.parse_args(argv)
+
+    print(f"steps/case: {args.steps}  step: {args.step_ms:.0f}ms  "
+          f"(stall = what the step loop pays per save; superseded = "
+          f"drop-to-latest drops, the writer's backpressure signal)")
+    print(f"{'state':>8} {'every':>6} {'mode':>6} {'stall ms':>9} "
+          f"{'max ms':>8} {'write s':>8} {'dropped':>8} {'run s':>6}")
+    for size in args.sizes_mb:
+        state = build_state(size)
+        for interval in args.intervals:
+            for sync in (True, False):
+                r = run_case(state, sync=sync, interval=interval,
+                             steps=args.steps, step_s=args.step_ms / 1e3)
+                print(f"{size:>6.0f}MB {interval:>6} "
+                      f"{'sync' if sync else 'async':>6} "
+                      f"{r['stall_ms']:>9.1f} {r['stall_ms_max']:>8.1f} "
+                      f"{r['write_s']:>8.3f} {r['superseded']:>8} "
+                      f"{r['run_s']:>6.2f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
