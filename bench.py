@@ -1068,6 +1068,54 @@ def bench_elastic_downtime(on_tpu: bool) -> dict:
                 child_stats.get("ckpt_save_stall_ms_mean")}
 
 
+def bench_scaler(on_tpu: bool) -> dict:
+    """Autoscaler decision quality on the deterministic simulator: how
+    fast the ThroughputPolicy closes on the oracle allocation and what
+    it pays getting there (edl_tpu/scaler; no training involved — the
+    decision plane itself is the system under test).
+
+    Per canonical curve shape (concave / flat / knee) from a mid-range
+    starting allocation: ticks until the LAST resize, the converged vs
+    oracle node gap, post-convergence resize count (must be 0), and the
+    stop-resume downtime paid — using the r9-measured 1.2s
+    elastic_downtime_s as the per-resize price. Deterministic (seeded
+    sim, virtual clock), so regressions here are policy regressions."""
+    from edl_tpu.scaler.policy import ThroughputPolicy
+    from edl_tpu.scaler.simulator import (SimCluster, SimJob, concave,
+                                          flat, knee, run_policy)
+    del on_tpu  # host-side decision plane: identical on every platform
+    cases = (("concave", concave(100.0, 0.5), 2),
+             ("flat", flat(100.0), 4),
+             ("knee", knee(100.0, 4), 7))
+    per_curve = {}
+    for name, curve, start in cases:
+        sim = SimCluster([SimJob("j", curve, 1, 8, nodes=start,
+                                 noise=0.01)],
+                         tick_s=5.0, downtime_s=1.2, seed=0)
+        policy = ThroughputPolicy(gain_threshold=0.05, cooldown_s=15.0,
+                                  horizon_s=60.0)
+        out = run_policy(sim, policy, ticks=150, settle_ticks=50)
+        job = out["jobs"]["j"]
+        per_curve[name] = {
+            "decisions_to_converge": job["decisions_to_converge"],
+            "gap_nodes": job["gap_nodes"],
+            "oracle_nodes": job["oracle_nodes"],
+            "final_nodes": job["final_nodes"],
+            "resizes": job["resizes"],
+            "downtime_paid_s": job["downtime_paid_s"],
+            "post_convergence_resizes": job["post_convergence_resizes"]}
+    return {
+        "scaler_decisions_to_converge": max(
+            c["decisions_to_converge"] for c in per_curve.values()),
+        "scaler_alloc_gap_nodes": max(
+            c["gap_nodes"] for c in per_curve.values()),
+        "scaler_downtime_paid_s": round(sum(
+            c["downtime_paid_s"] for c in per_curve.values()), 2),
+        "scaler_post_convergence_resizes": sum(
+            c["post_convergence_resizes"] for c in per_curve.values()),
+        "scaler_per_curve": per_curve}
+
+
 def distill_quality_extras() -> dict:
     """Surface the flagship distill QUALITY measurement (the reference's
     acc1 77.1->79.0 story) from the newest committed artifact —
@@ -1100,6 +1148,7 @@ def main() -> None:
     churn = bench_distill_churn(on_tpu)
     ckpt = bench_checkpoint(on_tpu)
     downtime = bench_elastic_downtime(on_tpu)
+    scaler = bench_scaler(on_tpu)
     cores_to_feed = (resnet["imgs_per_sec"]
                      / max(loader["imgs_per_sec_per_core"], 1e-9))
     print(json.dumps({
@@ -1205,6 +1254,10 @@ def main() -> None:
             # elastic stop-resume downtime: SIGKILL a trainer mid-run,
             # respawn, clock kill -> first post-restore step
             **downtime,
+            # autoscaler decision plane on the deterministic simulator:
+            # ticks-to-converge / vs-oracle gap / downtime paid across
+            # concave+flat+knee curves (edl_tpu/scaler)
+            **scaler,
             # flagship distill QUALITY (committed artifact; see
             # tools/distill_quality_tpu.py)
             **distill_quality_extras(),

@@ -52,10 +52,22 @@ class JobState:
 
     def resize(self, desired: int) -> dict:
         with self._lock:
+            clamped = not (self.min_nodes <= desired <= self.max_nodes)
             self.desired = max(self.min_nodes,
                                min(self.max_nodes, desired))
-            log.info("desired_nodes -> %d", self.desired)
-            return self.snapshot()
+            if clamped:
+                # loud, not silent: the scaler journals the response, so
+                # a clamp must be visible there and in this log
+                log.warning("resize request %d clamped to %d "
+                            "(range [%d, %d])", desired, self.desired,
+                            self.min_nodes, self.max_nodes)
+            else:
+                log.info("desired_nodes -> %d", self.desired)
+            snap = self.snapshot()
+            snap["clamped"] = clamped
+            if clamped:
+                snap["requested"] = desired
+            return snap
 
     def random_resize(self) -> dict:
         """Fault injection: pick a different node count in [min, max]."""
@@ -84,15 +96,39 @@ def _make_handler(state: JobState):
                 self._reply({"error": "not found"}, 404)
 
         def do_POST(self):
-            if self.path.rstrip("/") == "/resize":
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(n) or b"{}")
-                    self._reply(state.resize(int(payload["desired"])))
-                except (ValueError, KeyError) as exc:
-                    self._reply({"error": str(exc)}, 400)
-            else:
+            if self.path.rstrip("/") != "/resize":
                 self._reply({"error": "not found"}, 404)
+                return
+            # Validate the payload explicitly: every malformed request —
+            # bad JSON, non-object body, missing/non-integer `desired` —
+            # is a 400 with an error body, never a handler 500.
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+                self._reply({"error": f"malformed JSON: {exc}"}, 400)
+                return
+            if not isinstance(payload, dict):
+                self._reply({"error": "payload must be a JSON object"},
+                            400)
+                return
+            if "desired" not in payload:
+                self._reply({"error": "missing field 'desired'"}, 400)
+                return
+            desired = payload["desired"]
+            if isinstance(desired, bool) \
+                    or isinstance(desired, float) \
+                    and not desired.is_integer():
+                self._reply({"error": f"'desired' must be an integer, "
+                                      f"got {desired!r}"}, 400)
+                return
+            try:
+                desired = int(desired)
+            except (TypeError, ValueError):
+                self._reply({"error": f"'desired' must be an integer, "
+                                      f"got {desired!r}"}, 400)
+                return
+            self._reply(state.resize(desired))
 
         def log_message(self, fmt, *args):  # route into our logger
             log.debug("http: " + fmt, *args)
@@ -238,17 +274,61 @@ def main(argv=None) -> int:
     parser.add_argument("--time-interval-to-change", type=float, default=0.0,
                         help="fault injection: random resize every S seconds")
     parser.add_argument("--seed", type=int, default=0)
+    # scaler-driven mode: resizes come from the utilization-driven
+    # decision plane (edl_tpu/scaler) instead of the fault injector
+    parser.add_argument("--scaler", action="store_true",
+                        help="drive desired_nodes from the autoscaler "
+                             "(requires --store)")
+    parser.add_argument("--store", default=None,
+                        help="coordination store endpoint for --scaler")
+    parser.add_argument("--scaler-interval", type=float, default=None,
+                        help="decision interval s "
+                             "(EDL_TPU_SCALER_INTERVAL)")
+    parser.add_argument("--scaler-journal", default=None,
+                        help="JSON-lines decision journal file")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="scaler journals decisions without resizing")
     args = parser.parse_args(argv)
+    if args.scaler and not args.store:
+        parser.error("--scaler requires --store")
     lo, hi = (int(x) for x in args.nodes_range.split(":"))
     state = JobState(args.job_id, lo, hi, desired=args.desired,
                      seed=args.seed)
     server = JobServer(state, port=args.port, host=args.host,
                        time_interval_to_change=args.time_interval_to_change)
     server.start()
+    controller = store = None
+    if args.scaler:
+        from edl_tpu.coord.redis_store import connect_store
+        from edl_tpu.scaler.controller import (ScalerConfig,
+                                               ScalerController)
+        from edl_tpu.scaler.policy import ThroughputPolicy
+        from edl_tpu.utils.config import from_env
+        overrides = ({"interval": args.scaler_interval}
+                     if args.scaler_interval is not None else {})
+        config = from_env(ScalerConfig, **overrides)
+        config.min_nodes, config.max_nodes = lo, hi
+        store = connect_store(args.store)
+        # in-process actuation: no HTTP hop for limits or /resize
+        controller = ScalerController(
+            store, [args.job_id],
+            ThroughputPolicy(gain_threshold=config.gain_threshold,
+                             cooldown_s=config.cooldown_s),
+            config=config, dry_run=args.dry_run,
+            journal_path=args.scaler_journal,
+            actuate=lambda _job, desired: state.resize(desired)).start()
+        log.info("scaler-driven mode: decisions every %.1fs",
+                 config.interval)
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
+        pass
+    finally:
+        if controller is not None:
+            controller.stop()
+        if store is not None:
+            store.close()
         server.stop()
     return 0
 

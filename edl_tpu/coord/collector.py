@@ -14,7 +14,8 @@ Two halves:
 
 - `UtilizationPublisher` — trainer-side. A TrainLoop hook (same
   ``(loop, epoch, step, metrics)`` signature) that writes this pod's
-  ``{epoch, step, samples_seen, examples_per_sec}`` to the leased key
+  ``{epoch, step, samples_seen, examples_per_sec, world_size,
+  generation, published_unix}`` to the leased key
   ``/{job}/util/{pod_id}``; the lease makes staleness self-cleaning (a
   dead trainer's utilization disappears after TTL). TrainLoop installs
   one automatically when running under the elastic launcher
@@ -72,13 +73,19 @@ class UtilizationPublisher:
 
     def __init__(self, store: Store, job_id: str, pod_id: str, *,
                  rank: int = -1, ttl: float = 15.0,
-                 min_interval: float = 1.0):
+                 min_interval: float = 1.0, generation: int | None = None):
         self.store = store
         self.job_id = job_id
         self.pod_id = pod_id
         self.rank = rank
         self.ttl = ttl
         self.min_interval = min_interval
+        # cluster generation this trainer was launched into (the scaler
+        # correlates a rate with the allocation that produced it)
+        self.generation = generation
+        # `published_unix` must be monotonic per pod even across clock
+        # hiccups: the scaler's staleness check subtracts it from now()
+        self._pub_unix = 0.0
         self._lease: int | None = None
         self._keeper = None
         self._lock = threading.Lock()
@@ -118,7 +125,9 @@ class UtilizationPublisher:
                         "unreachable: %s)", exc)
             return None
         pub = cls(store, job_id, pod_id,
-                  rank=int(os.environ.get("EDL_TPU_RANK", "-1")))
+                  rank=int(os.environ.get("EDL_TPU_RANK", "-1")),
+                  generation=int(os.environ.get(
+                      "EDL_TPU_CLUSTER_VERSION", "0")) or None)
         pub._owns_store = True
         return pub
 
@@ -151,10 +160,20 @@ class UtilizationPublisher:
             rate = (samples - self._last_samples) / max(
                 now - self._last_t, 1e-9) if samples > self._last_samples \
                 else 0.0
+            # scaler contract: `published_unix` (monotonic non-decreasing
+            # staleness anchor — lease TTL alone only bounds death, not
+            # stale rates) + `world_size` (the allocation this rate was
+            # measured UNDER, so pre-resize records are filterable).
+            self._pub_unix = max(time.time(), self._pub_unix + 1e-4)
             doc = {"pod_id": self.pod_id, "rank": self.rank,
                    "epoch": int(epoch), "step": int(step),
                    "samples_seen": samples,
                    "examples_per_sec": round(max(rate, 0.0), 2),
+                   "world_size": int(getattr(
+                       getattr(loop, "status", None), "world_size", 0)
+                       or 0) if loop is not None else 0,
+                   "generation": self.generation,
+                   "published_unix": round(self._pub_unix, 4),
                    "ts": time.time()}
             self._last_pub = now
             self._last_samples = samples

@@ -8,6 +8,16 @@ multi-pod world it shards data by rank (orchestration-level elasticity —
 the same TrainLoop drives pjit models on real TPU meshes).
 
   python -m edl_tpu.examples.elastic_demo --epochs 5 --steps-per-epoch 20
+
+`--scaler` turns the demo into the full controller-driven elasticity
+loop on one host: an in-process store + JobServer + JobClient spawn
+launcher pods running THIS trainer, while a leader-elected
+`ScalerController` (edl_tpu/scaler) scrapes the trainers' published
+utilization and resizes the job through `/resize` — every decision
+journaled. The closed loop the reference's scheduler pillar describes,
+runnable on a laptop:
+
+  python -m edl_tpu.examples.elastic_demo --scaler --nodes-range 1:2
 """
 
 from __future__ import annotations
@@ -48,6 +58,112 @@ def make_data(epoch: int, rank: int, world: int, steps: int, batch: int):
         yield {"x": xs[s], "y": ys[s]}
 
 
+def run_scaler_demo(args) -> int:
+    """Controller-driven elasticity end-to-end on this host: store +
+    JobServer + JobClient-spawned launcher pods + ScalerController, all
+    wired to each other; returns non-zero if the job never completes or
+    a resize escaped the decision journal."""
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+    import time
+
+    from edl_tpu.collective import register as reg
+    from edl_tpu.collective.job_server import JobClient, JobServer, JobState
+    from edl_tpu.coord.server import StoreServer
+    from edl_tpu.scaler.controller import ScalerConfig, ScalerController
+    from edl_tpu.scaler.policy import ThroughputPolicy
+
+    # the spawned pods are CPU trainers (the orchestration is the demo);
+    # never let a child dial a TPU tunnel or fan out virtual devices
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_NUM_CPU_DEVICES"] = "1"
+
+    job_id = "scaler_demo"
+    lo, hi = (int(x) for x in args.nodes_range.split(":"))
+    tmp = tempfile.mkdtemp(prefix="edl-scaler-demo-")
+    journal_path = args.journal or os.path.join(tmp, "scaler.jsonl")
+    srv = StoreServer(port=0, host="127.0.0.1", sweep_interval=0.2).start()
+    store_ep = f"127.0.0.1:{srv.port}"
+    state = JobState(job_id, lo, hi, desired=lo)
+    server = JobServer(state, port=0).start()
+    trainer_cmd = [
+        sys.executable, "-m", "edl_tpu.collective.launch",
+        "--store", store_ep, "--job-id", job_id,
+        "--nodes-range", f"{lo}:{hi}",
+        "--checkpoint-path", os.path.join(tmp, "ckpt"),
+        "--log-dir", os.path.join(tmp, "log"), "--",
+        sys.executable, "-m", "edl_tpu.examples.elastic_demo",
+        "--epochs", str(args.epochs),
+        "--steps-per-epoch", str(args.steps_per_epoch),
+        "--batch", str(args.batch),
+        # pace the trainers a little by default: an instant run would
+        # complete before the scaler ever observes a utilization record
+        "--step-time", str(args.step_time or 0.05),
+        "--ckpt-steps", str(args.ckpt_steps or 10)]
+    client = JobClient(f"127.0.0.1:{server.port}", trainer_cmd, poll=0.5)
+    client_thread = threading.Thread(target=client.run, daemon=True,
+                                     name="scaler-demo-jobclient")
+    config = ScalerConfig(interval=args.scaler_interval,
+                          cooldown_s=args.scaler_cooldown,
+                          downtime_s=args.scaler_downtime,
+                          staleness_s=10.0)
+    controller = ScalerController(
+        srv.store, [job_id],
+        ThroughputPolicy(gain_threshold=config.gain_threshold,
+                         cooldown_s=config.cooldown_s,
+                         horizon_s=max(config.cooldown_s, 30.0)),
+        config=config, job_server=f"127.0.0.1:{server.port}",
+        journal_path=journal_path, owner="scaler-demo")
+    log.info("scaler demo: store=%s job_server=:%d nodes=%d:%d "
+             "journal=%s", store_ep, server.port, lo, hi, journal_path)
+    complete = False
+    try:
+        client_thread.start()
+        controller.start()
+        deadline = time.time() + args.scaler_timeout
+        while time.time() < deadline:
+            if srv.store.get(reg.complete_key(job_id)) is not None:
+                complete = True
+                break
+            time.sleep(0.5)
+    finally:
+        controller.stop()
+        client.stop()
+        client_thread.join(timeout=15)
+        for p in client.procs:  # belt and braces: no orphan launchers
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+        srv.stop()
+
+    entries = []
+    try:
+        with open(journal_path, encoding="utf-8") as f:
+            entries = [json.loads(line) for line in f if line.strip()]
+    except OSError:
+        pass
+    resizes = [e for e in entries if e["action"] == "resize"]
+    summary = {"complete": complete, "decisions": len(entries),
+               "resizes": [{"tick": e["seq"], "from": e["current"],
+                            "to": e["desired"], "reason": e["reason"]}
+                           for e in resizes],
+               "final_desired": state.desired,
+               "journal": journal_path if args.journal else None}
+    log.info("scaler demo done: complete=%s decisions=%d resizes=%d",
+             complete, len(entries), len(resizes))
+    # machine-readable (mirrors the ckpt_stats= convention bench.py reads)
+    print("scaler_summary=" + json.dumps(summary), flush=True)
+    if args.journal is None:
+        shutil.rmtree(tmp, ignore_errors=True)
+    else:
+        shutil.rmtree(os.path.join(tmp, "ckpt"), ignore_errors=True)
+    return 0 if complete else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--epochs", type=int, default=5)
@@ -61,7 +177,22 @@ def main(argv=None) -> int:
     parser.add_argument("--ckpt-sync", action="store_true",
                         help="synchronous saves (default async "
                              "snapshot-then-write)")
+    # controller-driven elasticity (see module docstring)
+    parser.add_argument("--scaler", action="store_true",
+                        help="run the closed loop: store + JobServer + "
+                             "launcher pods + utilization-driven scaler")
+    parser.add_argument("--nodes-range", default="1:2",
+                        help="--scaler: min:max pods on this host")
+    parser.add_argument("--scaler-interval", type=float, default=1.0)
+    parser.add_argument("--scaler-cooldown", type=float, default=8.0)
+    parser.add_argument("--scaler-downtime", type=float, default=1.5,
+                        help="measured elastic_downtime_s to amortize")
+    parser.add_argument("--scaler-timeout", type=float, default=300.0)
+    parser.add_argument("--journal", default=None,
+                        help="--scaler: keep the decision journal here")
     args = parser.parse_args(argv)
+    if args.scaler:
+        return run_scaler_demo(args)
 
     env = TrainerEnv.from_environ()
     log.info("trainer up: rank=%d world=%d cluster_v=%d", env.rank,

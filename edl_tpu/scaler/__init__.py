@@ -1,0 +1,24 @@
+"""Elastic autoscaler plane: utilization in, resize decisions out.
+
+jax-free by design (like the coord/ plane): the policies, simulator,
+and controller import nothing heavier than the coordination store, so
+`python -m edl_tpu.scaler` runs on a scheduler node with no
+accelerator stack installed.
+
+- `scaler.policy` — `ScalingPolicy` protocol, `ThroughputPolicy`
+  (marginal-gain autoscaling w/ hysteresis + downtime amortization),
+  `FairSharePolicy` (budget water-fill across jobs).
+- `scaler.controller` — leader-elected Collector->policy->JobServer
+  loop with a store+file decision journal and `--dry-run`.
+- `scaler.simulator` — deterministic `SimCluster` (synthetic scaling
+  curves, seeded noise, virtual time) for tests and benches.
+"""
+
+from edl_tpu.scaler.policy import (FairSharePolicy, JobView, Proposal,
+                                   ScalingPolicy, ThroughputPolicy)
+from edl_tpu.scaler.controller import (DecisionJournal, ScalerConfig,
+                                       ScalerController)
+
+__all__ = ["FairSharePolicy", "JobView", "Proposal", "ScalingPolicy",
+           "ThroughputPolicy", "DecisionJournal", "ScalerConfig",
+           "ScalerController"]

@@ -1,0 +1,93 @@
+"""Scaler CLI: run the decision plane against a live store + JobServer.
+
+    python -m edl_tpu.scaler --store STOREHOST:2379 --job myjob \
+        --server JOBSERVERHOST:8180 --interval 5
+
+    # observe-only (decisions journaled, nothing actuated):
+    python -m edl_tpu.scaler --store ... --job myjob --dry-run
+
+Flags are flag-else-env (`EDL_TPU_SCALER_*`; utils/config overlay).
+`--policy fairshare --budget N` scales several `--job`s against one
+node budget by marginal throughput.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from edl_tpu.scaler.controller import ScalerConfig, ScalerController
+from edl_tpu.scaler.policy import FairSharePolicy, ThroughputPolicy
+from edl_tpu.utils.config import from_env
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="edl_tpu.scaler",
+        description="Elastic autoscaler: Collector -> policy -> JobServer")
+    parser.add_argument("--store", required=True,
+                        help="store endpoint (host:port or redis://...)")
+    parser.add_argument("--job", action="append", default=[],
+                        dest="jobs", help="job id (repeatable)")
+    parser.add_argument("--server", default=None,
+                        help="JobServer host:port for limits + /resize")
+    parser.add_argument("--policy", choices=("throughput", "fairshare"),
+                        default="throughput")
+    parser.add_argument("--budget", type=int, default=None,
+                        help="node budget (fairshare policy)")
+    parser.add_argument("--interval", type=float, default=None,
+                        help="decision interval s "
+                             "(EDL_TPU_SCALER_INTERVAL)")
+    parser.add_argument("--cooldown", type=float, default=None,
+                        help="per-job seconds between resizes")
+    parser.add_argument("--gain-threshold", type=float, default=None,
+                        help="hysteresis: min relative marginal gain")
+    parser.add_argument("--downtime-s", type=float, default=None,
+                        help="measured elastic_downtime_s to amortize "
+                             "(EDL_TPU_ELASTIC_DOWNTIME_S)")
+    parser.add_argument("--journal", default=None,
+                        help="JSON-lines decision journal file")
+    parser.add_argument("--dry-run", action="store_true",
+                        help="journal decisions without actuating")
+    parser.add_argument("--once", action="store_true",
+                        help="one tick (skips leader election), then exit")
+    args = parser.parse_args(argv)
+    if not args.jobs:
+        parser.error("at least one --job is required")
+    if args.policy == "fairshare" and args.budget is None:
+        parser.error("--policy fairshare requires --budget")
+
+    overrides = {k: v for k, v in (
+        ("interval", args.interval), ("cooldown_s", args.cooldown),
+        ("gain_threshold", args.gain_threshold),
+        ("downtime_s", args.downtime_s)) if v is not None}
+    config = from_env(ScalerConfig, **overrides)
+    policy_kw = dict(gain_threshold=config.gain_threshold,
+                     cooldown_s=config.cooldown_s)
+    policy = (FairSharePolicy(args.budget, **policy_kw)
+              if args.policy == "fairshare"
+              else ThroughputPolicy(**policy_kw))
+
+    from edl_tpu.coord.redis_store import connect_store
+    store = connect_store(args.store)
+    controller = ScalerController(
+        store, args.jobs, policy, config=config,
+        job_server=args.server, dry_run=args.dry_run,
+        journal_path=args.journal, elect=not args.once)
+    try:
+        if args.once:
+            for entry in controller.tick():
+                print(json.dumps(entry, sort_keys=True), flush=True)
+            return 0
+        controller.run()
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        controller.stop()
+        store.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,319 @@
+"""The scaler control loop: Collector snapshots -> policy -> JobServer.
+
+Closes the loop the reference reserved the registry ``info`` field for
+("report job performance to the scheduler"): a leader-elected
+controller scrapes each job's `Collector` snapshot, digests it into a
+`JobView` (aggregate fresh throughput, live world size, generation),
+asks the policy, and actuates accepted proposals through the
+JobServer's ``/resize`` endpoint — or only journals them under
+``--dry-run``.
+
+Exactly one scaler acts: controllers campaign on a lease-backed
+leadership key (`coord/lock.LeaderElection`); a follower's ticks are
+no-ops, and on takeover the new leader replays the decision journal's
+tail to re-learn the throughput models and resume the cooldown clocks
+(so a leader crash never causes a double resize).
+
+Every decision — hold or resize, with its inputs and reason — is one
+JSON journal entry, appended both as a JSON line to ``journal_path``
+(observability; ``tail -f``-able) and under the store prefix
+``/{scope}/scaler/journal/`` (bounded retention; what a successor
+replays).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from edl_tpu.coord.collector import Collector
+from edl_tpu.coord.store import Store
+from edl_tpu.scaler.policy import JobView, Proposal, ScalingPolicy
+from edl_tpu.utils.config import field
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.scaler.controller")
+
+
+@dataclass
+class ScalerConfig:
+    interval: float = field(5.0, env="EDL_TPU_SCALER_INTERVAL")
+    cooldown_s: float = field(30.0, env="EDL_TPU_SCALER_COOLDOWN")
+    gain_threshold: float = field(0.05, env="EDL_TPU_SCALER_GAIN")
+    # the measured stop-resume price (bench.py elastic_downtime_s) the
+    # policy amortizes every resize against
+    downtime_s: float = field(1.5, env="EDL_TPU_ELASTIC_DOWNTIME_S")
+    # utilization docs older than this are ignored (published_unix)
+    staleness_s: float = field(15.0, env="EDL_TPU_SCALER_STALENESS")
+    min_nodes: int = field(1, env="EDL_TPU_SCALER_MIN_NODES")
+    max_nodes: int = field(8, env="EDL_TPU_SCALER_MAX_NODES")
+    journal_keep: int = 512
+    leader_ttl: float = field(10.0, env="EDL_TPU_SCALER_LEADER_TTL")
+
+
+def journal_prefix(scope: str) -> str:
+    return f"/{scope}/scaler/journal/"
+
+
+def leader_key(scope: str) -> str:
+    return f"/{scope}/scaler/leader"
+
+
+class DecisionJournal:
+    """Append-only decision log: store-backed tail + local JSON lines.
+
+    The store half is the handoff medium (a successor leader replays
+    it); the file half is the operator's observability surface. Entries
+    are sequence-numbered store keys so lexicographic prefix order IS
+    replay order; retention keeps the newest `keep` entries.
+    """
+
+    def __init__(self, store: Store | None, scope: str, *,
+                 path: str | None = None, keep: int = 512):
+        self.store = store
+        self.scope = scope
+        self.path = path
+        self.keep = keep
+        self._fh = open(path, "a", encoding="utf-8") if path else None
+        self._seq = self._last_seq() + 1
+
+    def _last_seq(self) -> int:
+        if self.store is None:
+            return -1
+        records, _ = self.store.get_prefix(journal_prefix(self.scope))
+        if not records:
+            return -1
+        return int(records[-1].key.rsplit("/", 1)[-1])
+
+    def append(self, entry: dict) -> dict:
+        entry = dict(entry, seq=self._seq)
+        line = json.dumps(entry, sort_keys=True)
+        if self.store is not None:
+            prefix = journal_prefix(self.scope)
+            self.store.put(f"{prefix}{self._seq:010d}", line)
+            drop = self._seq - self.keep
+            if drop >= 0:
+                self.store.delete(f"{prefix}{drop:010d}")
+        if self._fh is not None:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        self._seq += 1
+        return entry
+
+    def tail(self, n: int | None = None) -> list[dict]:
+        if self.store is None:
+            return []
+        records, _ = self.store.get_prefix(journal_prefix(self.scope))
+        if n is not None:
+            records = records[-n:]
+        out = []
+        for rec in records:
+            try:
+                out.append(json.loads(rec.value))
+            except json.JSONDecodeError:
+                continue
+        return out
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class ScalerController:
+    """Scrape -> decide -> actuate -> journal, while leader.
+
+    Args:
+      store: coordination store (same one the job runs on).
+      jobs: job ids to scale.
+      policy: a `ScalingPolicy`.
+      job_server: JobServer endpoint ("host:port") for min/max/desired
+        and `/resize` actuation; None = store-only (observe + journal).
+      actuate: override actuation, e.g. a local `JobState.resize` when
+        the controller runs inside the JobServer process. Signature
+        ``(job_id, desired) -> snapshot dict``.
+      dry_run: never actuate; decisions are journaled with action
+        "dry-run".
+      clock: injectable time source (tests); defaults to time.time so
+        journal timestamps and `published_unix` share one scale.
+    """
+
+    def __init__(self, store: Store, jobs: list[str],
+                 policy: ScalingPolicy, *,
+                 config: ScalerConfig | None = None,
+                 job_server: str | None = None,
+                 actuate: Callable[[str, int], dict] | None = None,
+                 dry_run: bool = False,
+                 journal_path: str | None = None,
+                 scope: str | None = None,
+                 owner: str | None = None,
+                 elect: bool = True,
+                 clock: Callable[[], float] = time.time):
+        self.store = store
+        self.jobs = list(jobs)
+        self.policy = policy
+        self.config = config or ScalerConfig()
+        self.job_server = job_server
+        self._actuate_fn = actuate
+        self.dry_run = dry_run
+        self.scope = scope or (self.jobs[0] if len(self.jobs) == 1
+                               else "cluster")
+        self.owner = owner or f"{socket.gethostname()}-{os.getpid()}"
+        self.clock = clock
+        self.journal = DecisionJournal(store, self.scope,
+                                       path=journal_path,
+                                       keep=self.config.journal_keep)
+        self._collectors = {j: Collector(store, job_id=j)
+                            for j in self.jobs}
+        self.election = None
+        if elect:
+            from edl_tpu.coord.lock import LeaderElection
+            self.election = LeaderElection(
+                store, leader_key(self.scope), self.owner,
+                ttl=self.config.leader_ttl)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._restored = False
+
+    # -- observation --------------------------------------------------------
+
+    def _job_limits(self, job_id: str) -> tuple[int, int, int | None]:
+        """(min, max, desired) from the JobServer, else config defaults."""
+        if self.job_server is not None:
+            from edl_tpu.collective.job_server import get_job
+            try:
+                doc = get_job(self.job_server)
+                return (int(doc["min_nodes"]), int(doc["max_nodes"]),
+                        int(doc["desired_nodes"]))
+            except (OSError, KeyError, ValueError) as exc:
+                log.warning("job server unreachable (%s); using config "
+                            "limits", exc)
+        return self.config.min_nodes, self.config.max_nodes, None
+
+    def observe(self, job_id: str, now: float | None = None) -> JobView:
+        """Digest one Collector snapshot into the policy's JobView."""
+        now = self.clock() if now is None else now
+        snap = self._collectors[job_id].snapshot()
+        job = snap.get("job") or {}
+        world = int(job.get("world_size") or 0)
+        lo, hi, desired = self._job_limits(job_id)
+        throughput, fresh_pods = 0.0, 0
+        for pod in job.get("pods") or []:
+            util = pod.get("utilization")
+            if not isinstance(util, dict):
+                continue
+            published = util.get("published_unix", util.get("ts"))
+            if published is None \
+                    or now - float(published) > self.config.staleness_s:
+                continue  # stale: a dead pod's lease hasn't expired yet
+            pod_world = util.get("world_size")
+            if pod_world is not None and world and int(pod_world) != world:
+                continue  # pre-resize record: wrong allocation's rate
+            throughput += float(util.get("examples_per_sec", 0.0))
+            fresh_pods += 1
+        return JobView(job_id, world, throughput, lo, hi,
+                       self.config.downtime_s,
+                       generation=job.get("generation"),
+                       desired=desired,
+                       fresh=bool(fresh_pods) and world > 0)
+
+    # -- actuation ----------------------------------------------------------
+
+    def _actuate(self, job_id: str, desired: int) -> dict:
+        if self._actuate_fn is not None:
+            return self._actuate_fn(job_id, desired)
+        if self.job_server is None:
+            raise RuntimeError("no actuation path (job_server/actuate)")
+        from edl_tpu.collective.job_server import request_resize
+        return request_resize(self.job_server, desired)
+
+    # -- the loop -----------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        return self.election is None or self.election.is_leader()
+
+    def _restore_from_journal(self) -> None:
+        entries = self.journal.tail()
+        if entries:
+            self.policy.restore(entries)
+            log.info("restored %d journal entries (scope %s)",
+                     len(entries), self.scope)
+        self._restored = True
+
+    def tick(self, now: float | None = None) -> list[dict]:
+        """One decision pass; returns the journal entries it wrote."""
+        if not self.is_leader():
+            return []
+        if not self._restored:
+            self._restore_from_journal()
+        now = self.clock() if now is None else now
+        views = [self.observe(j, now) for j in self.jobs]
+        proposals = self.policy.decide(views, now)
+        entries = []
+        for view, prop in zip(views, proposals):
+            entries.append(self._apply(view, prop, now))
+        return entries
+
+    def _apply(self, view: JobView, prop: Proposal, now: float) -> dict:
+        action, reason = "hold", prop.reason
+        applied = None
+        if prop.is_resize:
+            if self.dry_run:
+                action = "dry-run"
+            else:
+                try:
+                    resp = self._actuate(view.job_id, prop.desired)
+                    applied = int(resp.get("desired_nodes", prop.desired))
+                    action = "resize"
+                    if resp.get("clamped"):
+                        reason += "; clamped by job server"
+                    self.policy.notify_resized(view.job_id, applied, now)
+                    log.info("resize %s: %d -> %d (%s)", view.job_id,
+                             prop.current, applied, prop.reason)
+                except Exception as exc:  # noqa: BLE001 — journal it;
+                    # a dead job server must not kill the control loop
+                    action, reason = "error", f"{prop.reason}; {exc}"
+        return self.journal.append({
+            "ts": now, "job_id": view.job_id, "leader": self.owner,
+            "world_size": view.world_size,
+            "throughput": round(view.throughput, 3),
+            "generation": view.generation, "fresh": view.fresh,
+            "current": prop.current, "desired": prop.desired,
+            "applied": applied, "action": action, "reason": reason,
+            "predicted_gain": (round(prop.predicted_gain, 3)
+                               if prop.predicted_gain is not None
+                               else None)})
+
+    def run(self) -> None:
+        """Campaign, then tick every interval while leader (blocking)."""
+        while not self._stop.is_set():
+            if self.election is not None and not self.election.is_leader():
+                if not self.election.campaign(timeout=1.0):
+                    continue
+                log.info("scaler leadership acquired (%s)", self.owner)
+                self._restored = False  # re-replay on every takeover
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — scrape failures are
+                log.exception("scaler tick failed")  # transient: keep going
+            self._stop.wait(self.config.interval)
+
+    def start(self) -> "ScalerController":
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name="edl-scaler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self.election is not None:
+            self.election.resign()
+        self.journal.close()

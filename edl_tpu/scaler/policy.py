@@ -1,0 +1,377 @@
+"""Scaling policies: utilization in, resize proposals out.
+
+The decision half of the reference's defining pillar — the TrainingJob
+controller that grows/shrinks a job's node count from observed
+utilization (SURVEY §1; `coord/collector.py` publishes exactly the
+records the registry `info` field was reserved for). Policies are pure
+state machines over (world_size, throughput) observations: no store, no
+HTTP, no wall clock — the caller supplies `now`, which is what makes
+them drivable by both the live controller (`scaler/controller.py`) and
+the deterministic simulator (`scaler/simulator.py`).
+
+Two policies, in the spirit of goodput-driven elastic schedulers
+(Pollux) and cluster-wide dynamic scaling (AntMan):
+
+- `ThroughputPolicy` — single-job autoscaling. Fits a throughput-vs-
+  world-size curve from observed rates, probes unexplored sizes while
+  the measured marginal gain clears a threshold, and settles on the
+  smallest allocation within the hysteresis band of the best known
+  rate. Every grow must amortize: predicted extra samples before the
+  next decision must exceed the samples lost to the resize downtime
+  (the measured `elastic_downtime_s`), so a resize that can't pay for
+  itself is never proposed.
+- `FairSharePolicy` — multi-job: water-fills a fixed node budget by
+  marginal throughput (each next node goes to the job whose curve says
+  it gains most), honoring per-job min/max, shrink-before-grow so the
+  budget is never transiently exceeded.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Protocol, runtime_checkable
+
+
+@dataclass
+class JobView:
+    """One job's state at one decision instant (a Collector digest)."""
+
+    job_id: str
+    world_size: int            # current allocation (live cluster world)
+    throughput: float          # aggregate fresh examples/sec across pods
+    min_nodes: int = 1
+    max_nodes: int = 8
+    downtime_s: float = 1.5    # measured stop-resume price of one resize
+    generation: int | None = None
+    desired: int | None = None  # job-server desired (None = world_size)
+    fresh: bool = True         # False: stale/reforming — do not learn
+
+    @property
+    def effective_desired(self) -> int:
+        return self.world_size if self.desired is None else self.desired
+
+
+@dataclass
+class Proposal:
+    """Policy output for one job: resize to `desired`, or hold + why."""
+
+    job_id: str
+    current: int
+    desired: int
+    reason: str
+    predicted_gain: float | None = None  # examples/sec delta (grows)
+
+    @property
+    def is_resize(self) -> bool:
+        return self.desired != self.current
+
+
+@runtime_checkable
+class ScalingPolicy(Protocol):
+    """The policy contract the controller and simulator drive."""
+
+    def decide(self, views: list[JobView], now: float) -> list[Proposal]:
+        """One decision pass; returns a Proposal per view, same order."""
+        ...
+
+    def notify_resized(self, job_id: str, desired: int, now: float) -> None:
+        """Actuation feedback: starts the job's cooldown clock."""
+        ...
+
+    def restore(self, entries: list[dict]) -> None:
+        """Warm-start from journal entries (leader takeover)."""
+        ...
+
+
+class ThroughputModel:
+    """EWMA throughput per observed world size + curve extrapolation.
+
+    Known sizes answer with their smoothed mean; unknown sizes get a
+    power-law fit ``T = c * n^a`` (log-log least squares, ``a`` clamped
+    to [0, 1.2]) once two distinct sizes exist, else an optimistic
+    linear extension of the single known point — optimism is what makes
+    an unexplored size worth probing.
+    """
+
+    def __init__(self, ema: float = 0.3):
+        self.ema = ema
+        self._mean: dict[int, float] = {}
+        self._count: dict[int, int] = {}
+
+    def observe(self, n: int, rate: float) -> None:
+        if n < 1 or rate < 0:
+            return
+        if n in self._mean:
+            self._mean[n] += self.ema * (rate - self._mean[n])
+        else:
+            self._mean[n] = float(rate)
+        self._count[n] = self._count.get(n, 0) + 1
+
+    def known(self) -> list[int]:
+        return sorted(self._mean)
+
+    def observed(self, n: int) -> float | None:
+        return self._mean.get(n)
+
+    def predict(self, n: int) -> float | None:
+        if n in self._mean:
+            return self._mean[n]
+        pts = [(k, v) for k, v in self._mean.items() if v > 0]
+        if len(pts) >= 2:
+            xs = [math.log(k) for k, _ in pts]
+            ys = [math.log(v) for _, v in pts]
+            mx, my = sum(xs) / len(xs), sum(ys) / len(ys)
+            denom = sum((x - mx) ** 2 for x in xs)
+            a = (sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
+                 if denom > 0 else 0.0)
+            a = max(0.0, min(a, 1.2))
+            return math.exp(my - a * mx) * n ** a
+        if len(pts) == 1:
+            k, v = pts[0]
+            return v * n / k
+        return None
+
+    def marginal(self, n1: int, n2: int) -> float | None:
+        """Relative per-node gain going n1 -> n2 from OBSERVED means."""
+        t1, t2 = self.observed(n1), self.observed(n2)
+        if t1 is None or t2 is None or t1 <= 0 or n2 <= n1:
+            return None
+        return (t2 - t1) / (t1 * (n2 - n1))
+
+
+class _PolicyBase:
+    """Shared observation intake, cooldown clocks, journal restore."""
+
+    def __init__(self, *, gain_threshold: float = 0.05,
+                 cooldown_s: float = 30.0, horizon_s: float | None = None,
+                 ema: float = 0.3):
+        self.gain_threshold = gain_threshold
+        self.cooldown_s = cooldown_s
+        # Amortization horizon: how long a new allocation runs before
+        # the next decision can change it — the window a resize must
+        # pay for itself within. Cooldown is that window's floor.
+        self.horizon_s = cooldown_s if horizon_s is None else horizon_s
+        self.ema = ema
+        self._models: dict[str, ThroughputModel] = {}
+        self._resized_at: dict[str, float] = {}
+
+    def model(self, job_id: str) -> ThroughputModel:
+        return self._models.setdefault(job_id, ThroughputModel(self.ema))
+
+    def _intake(self, view: JobView, now: float) -> str | None:
+        """Record the observation when trustworthy; else return the
+        hold reason that makes this tick a no-op for the job."""
+        resized_at = self._resized_at.get(view.job_id)
+        settling = (resized_at is not None
+                    and now - resized_at < view.downtime_s)
+        if view.fresh and not settling and view.world_size >= 1 \
+                and view.effective_desired == view.world_size:
+            self.model(view.job_id).observe(view.world_size,
+                                            view.throughput)
+        if not view.fresh:
+            return "no-fresh-utilization"
+        if view.effective_desired != view.world_size:
+            return "resize-in-flight"
+        if settling:
+            return "settling-after-resize"
+        if resized_at is not None and now - resized_at < self.cooldown_s:
+            return "cooldown"
+        return None
+
+    def _amortizes(self, gain_per_sec: float, view: JobView) -> bool:
+        """True when the predicted gain repays the downtime before the
+        next decision: gain * (horizon - downtime) > downtime * T_now."""
+        usable = self.horizon_s - view.downtime_s
+        if usable <= 0:
+            return False
+        return gain_per_sec * usable > view.downtime_s * view.throughput
+
+    def notify_resized(self, job_id: str, desired: int, now: float) -> None:
+        self._resized_at[job_id] = now
+
+    def restore(self, entries: list[dict]) -> None:
+        """Replay journal entries (seq order): re-learn the models from
+        the recorded observations and resume the cooldown clocks, so a
+        takeover leader neither forgets the curve nor double-resizes."""
+        for e in entries:
+            job = e.get("job_id")
+            if not job:
+                continue
+            if e.get("fresh") and e.get("world_size", 0) >= 1 \
+                    and e.get("throughput") is not None:
+                self.model(job).observe(int(e["world_size"]),
+                                        float(e["throughput"]))
+            if e.get("action") == "resize":
+                self._resized_at[job] = float(e.get("ts", 0.0))
+
+
+class ThroughputPolicy(_PolicyBase):
+    """Marginal-gain-positive autoscaling for independent jobs.
+
+    Per decision (after cooldown/freshness gates):
+
+    1. *recover* — if the current size runs > 2x the hysteresis band
+       below the best known rate (we shrank past the knee), grow back
+       to the settle size (amortization-gated).
+    2. *probe-up* — while sitting at the largest explored size and the
+       top observed marginal still clears `gain_threshold` (or fewer
+       than two sizes are known), try one node more. Gated by the
+       optimistic amortization bound (one node's perfect-scaling
+       contribution must repay the downtime).
+    3. *probe-down* — while sitting at the smallest explored size and
+       the bottom marginal is below threshold (flat down here), try one
+       node less: frees capacity at no predicted cost.
+    4. *settle* — shrink to the smallest known size within the
+       hysteresis band of the best known rate.
+    5. otherwise hold (*converged*).
+
+    The asymmetric bands (shrink within `gain_threshold`, grow back
+    only past `2 * gain_threshold`) are the anti-oscillation margin: a
+    noisy flat curve cannot alternate proposals, because the rates that
+    would trigger a shrink and the rates that would trigger the
+    corresponding grow-back are separated by a dead zone wider than the
+    smoothed noise.
+    """
+
+    def decide(self, views: list[JobView], now: float) -> list[Proposal]:
+        return [self._decide_one(v, now) for v in views]
+
+    def _decide_one(self, view: JobView, now: float) -> Proposal:
+        job, cur = view.job_id, view.world_size
+        hold = self._intake(view, now)
+        if hold is not None:
+            return Proposal(job, cur, cur, hold)
+        model = self.model(job)
+        known = model.known()
+        if not known:
+            return Proposal(job, cur, cur, "no-observations")
+        eps = self.gain_threshold
+        best = max(model.observed(n) for n in known)
+        settle_n = min(n for n in known
+                       if model.observed(n) >= (1.0 - eps) * best)
+        t_cur = model.observed(cur)
+
+        # 1. recover: we sit measurably below the best known rate.
+        if t_cur is not None and best > 0 and settle_n > cur \
+                and t_cur < (1.0 - 2.0 * eps) * best:
+            gain = model.observed(settle_n) - t_cur
+            if self._amortizes(gain, view):
+                return Proposal(job, cur, settle_n,
+                                "recover-to-best-known", gain)
+            return Proposal(job, cur, cur, "recover-unamortized", gain)
+
+        top, bottom = known[-1], known[0]
+        # 2. probe up: unexplored room above and the curve still climbs.
+        if cur == top and top < view.max_nodes:
+            top_marginal = (model.marginal(known[-2], top)
+                            if len(known) >= 2 else None)
+            if top_marginal is None or top_marginal >= eps:
+                optimistic = (t_cur / cur) if t_cur and cur else 0.0
+                if t_cur is None or t_cur == 0 \
+                        or self._amortizes(optimistic, view):
+                    return Proposal(job, cur, cur + 1, "probe-up",
+                                    optimistic or None)
+                return Proposal(job, cur, cur, "probe-up-unamortized",
+                                optimistic)
+        # 3. probe down: flat at the bottom of the explored range.
+        if cur == bottom and bottom > view.min_nodes and len(known) >= 2:
+            if (model.marginal(bottom, known[1]) or 0.0) < eps:
+                return Proposal(job, cur, cur - 1, "probe-down")
+        # 4. settle: smallest allocation within the hysteresis band.
+        if settle_n < cur:
+            return Proposal(job, cur, settle_n,
+                            "settle-to-marginal-gain-positive")
+        return Proposal(job, cur, cur, "converged")
+
+
+class FairSharePolicy(_PolicyBase):
+    """Split a fixed node budget across jobs by marginal throughput.
+
+    Water-filling: every job starts at its `min_nodes`; each remaining
+    budget node goes to the job whose model predicts the largest gain
+    from one more node (unexplored jobs predict optimistically, so they
+    attract exploration). Proposals then reconcile the plan against the
+    live allocations shrink-before-grow: grows are admitted only while
+    the post-shrink total stays within budget, so the cluster never
+    transiently exceeds it even when cooldowns stagger the actuations.
+    """
+
+    def __init__(self, budget: int, **kw):
+        super().__init__(**kw)
+        self.budget = budget
+
+    def plan(self, views: list[JobView]) -> dict[str, int]:
+        """The budget split this tick's models recommend."""
+        alloc: dict[str, int] = {}
+        left = self.budget
+        for v in views:  # mins first, in view order, never past budget
+            grant = min(v.min_nodes, max(left, 0))
+            alloc[v.job_id] = grant
+            left -= grant
+        while left > 0:
+            best_job, best_gain = None, 0.0
+            for v in views:
+                n = alloc[v.job_id]
+                if n >= v.max_nodes:
+                    continue
+                model = self.model(v.job_id)
+                t0, t1 = model.predict(n), model.predict(n + 1)
+                # unexplored job: unit-linear optimism (explore it)
+                gain = (t1 - t0) if t0 is not None and t1 is not None \
+                    else 1.0
+                if best_job is None or gain > best_gain:
+                    best_job, best_gain = v.job_id, gain
+            if best_job is None:
+                break
+            alloc[best_job] += 1
+            left -= 1
+        # clamp to each job's range (budget < sum(min) leaves a job
+        # under its min; it must still be a legal allocation)
+        for v in views:
+            alloc[v.job_id] = max(min(alloc[v.job_id], v.max_nodes),
+                                  0 if alloc[v.job_id] < v.min_nodes
+                                  else v.min_nodes)
+        return alloc
+
+    def decide(self, views: list[JobView], now: float) -> list[Proposal]:
+        holds = {v.job_id: self._intake(v, now) for v in views}
+        alloc = self.plan(views)
+        proposals: dict[str, Proposal] = {}
+        # shrink-before-grow: shrinks free budget grows then consume
+        total = sum(v.effective_desired for v in views)
+        for v in sorted(views, key=lambda v: alloc[v.job_id]
+                        - v.effective_desired):
+            job, cur = v.job_id, v.world_size
+            desired = alloc[job]
+            if holds[job] is not None:
+                proposals[job] = Proposal(job, cur, cur, holds[job])
+                continue
+            if desired == cur:
+                proposals[job] = Proposal(job, cur, cur, "converged")
+                continue
+            delta = desired - v.effective_desired
+            if delta > 0:
+                model = self.model(job)
+                t0, t1 = model.predict(cur), model.predict(desired)
+                gain = (t1 - t0) if t0 is not None and t1 is not None \
+                    else None
+                if gain is not None and gain <= 0:
+                    proposals[job] = Proposal(job, cur, cur,
+                                              "no-marginal-gain", gain)
+                    continue
+                if gain is not None and not self._amortizes(gain, v):
+                    proposals[job] = Proposal(job, cur, cur,
+                                              "grow-unamortized", gain)
+                    continue
+                if total + delta > self.budget:
+                    proposals[job] = Proposal(job, cur, cur,
+                                              "awaiting-budget", gain)
+                    continue
+                proposals[job] = Proposal(job, cur, desired,
+                                          "fair-share-grow", gain)
+            else:
+                proposals[job] = Proposal(job, cur, desired,
+                                          "fair-share-shrink")
+            total += desired - v.effective_desired
+        return [proposals[v.job_id] for v in views]

@@ -143,6 +143,33 @@ class TestUtilizationPublisher:
         pub.stop()
         assert store.get(util_key("j1", "podA")) is None  # lease revoked
 
+    def test_doc_carries_scaler_contract_fields(self):
+        """The autoscaler's staleness + correlation anchors: a
+        monotonic `published_unix` and the world size the rate was
+        measured under (edl_tpu/scaler reads both)."""
+
+        class _Loop:
+            class status:
+                samples_seen = 128
+                world_size = 4
+
+        store = InMemStore()
+        pub = UtilizationPublisher(store, "j1", "podA", min_interval=0.0,
+                                   generation=7)
+        loop = _Loop()
+        stamps = []
+        for step in (1, 2, 3):
+            loop.status.samples_seen = 128 * step
+            pub(loop, 0, step, {})
+            assert pub.flush()
+            doc = json.loads(store.get(util_key("j1", "podA")).value)
+            stamps.append(doc["published_unix"])
+            assert doc["world_size"] == 4
+            assert doc["generation"] == 7
+        assert stamps == sorted(stamps)
+        assert len(set(stamps)) == 3  # strictly increasing
+        pub.stop()
+
     def test_store_failure_never_raises(self):
         class _Broken:
             def lease_grant(self, ttl):

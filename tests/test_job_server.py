@@ -35,6 +35,72 @@ def test_get_and_resize():
         server.stop()
 
 
+def _raw_post(addr: str, body: bytes):
+    """POST /resize with an arbitrary body; (status, parsed JSON)."""
+    import json
+    import urllib.error
+    import urllib.request
+    req = urllib.request.Request(
+        f"http://{addr}/resize", method="POST", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def test_resize_rejects_bad_payloads_with_400():
+    """Malformed JSON / non-object / missing / non-integer `desired`
+    are client errors with an error body — never a handler 500."""
+    server = make_server()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        for body in (b"{not json", b"[1, 2]", b"{}",
+                     b'{"desired": "lots"}', b'{"desired": 2.5}',
+                     b'{"desired": true}', b'{"desired": null}',
+                     b'{"desired": [3]}'):
+            status, doc = _raw_post(addr, body)
+            assert status == 400, body
+            assert "error" in doc, body
+        # the state survived every bad request untouched
+        assert get_job(addr)["desired_nodes"] == 2
+        # integer-valued floats and numeric strings still work
+        assert _raw_post(addr, b'{"desired": 3.0}')[1][
+            "desired_nodes"] == 3
+    finally:
+        server.stop()
+
+
+def test_resize_clamp_is_visible():
+    """An out-of-range request is clamped LOUDLY: warning logged and
+    the response marks it for the scaler's decision journal."""
+    import logging
+
+    class _Capture(logging.Handler):
+        def __init__(self):
+            super().__init__(level=logging.WARNING)
+            self.records = []
+
+        def emit(self, record):
+            self.records.append(record)
+
+    # the repo logger sets propagate=False, so attach directly
+    capture = _Capture()
+    logger = logging.getLogger("edl_tpu.collective.job_server")
+    logger.addHandler(capture)
+    try:
+        state = JobState("j1", 1, 4, desired=2)
+        out = state.resize(99)
+        assert out["desired_nodes"] == 4
+        assert out["clamped"] is True and out["requested"] == 99
+        assert any("clamped" in r.getMessage()
+                   for r in capture.records)
+        assert state.resize(3).get("clamped") is False
+    finally:
+        logger.removeHandler(capture)
+
+
 def test_fault_injection_changes_desired():
     state = JobState("j1", 1, 4, desired=2, seed=7)
     server = JobServer(state, port=0, time_interval_to_change=0.1).start()

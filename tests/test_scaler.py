@@ -1,0 +1,339 @@
+"""Elastic autoscaler plane: policies, simulator, controller, handoff.
+
+The decision half of the reference's TrainingJob-controller pillar
+(SURVEY §1): utilization-driven resize decisions. Policy behavior is
+pinned against the deterministic `SimCluster` (virtual time, seeded
+noise, oracle allocations from the true curves); the controller tier
+runs over InMemStore + a real JobServer on a loopback port.
+"""
+
+import json
+import time
+
+import pytest
+
+from edl_tpu.coord.store import InMemStore
+from edl_tpu.scaler.controller import (DecisionJournal, ScalerConfig,
+                                       ScalerController, journal_prefix)
+from edl_tpu.scaler.policy import (FairSharePolicy, JobView,
+                                   ThroughputPolicy)
+from edl_tpu.scaler.simulator import (SimCluster, SimJob, concave, flat,
+                                      knee, linear, run_policy)
+
+
+def make_policy(**kw):
+    kw.setdefault("gain_threshold", 0.05)
+    kw.setdefault("cooldown_s", 15.0)
+    kw.setdefault("horizon_s", 60.0)
+    return ThroughputPolicy(**kw)
+
+
+class TestThroughputPolicy:
+    @pytest.mark.parametrize("name,curve,start", [
+        ("concave-steep", concave(100, 0.3), 1),
+        ("concave-gentle", concave(100, 0.6), 2),
+        ("flat-from-above", flat(100), 3),
+        ("knee-from-above", knee(100, 4), 7),
+        ("knee-from-below", knee(100, 4), 1),
+        ("linear", linear(100), 1),
+    ])
+    def test_converges_to_oracle_without_oscillating(self, name, curve,
+                                                     start):
+        """The acceptance bar: within 1 node of the oracle allocation on
+        concave, flat, and knee curves, with ZERO post-convergence
+        resizes over the trailing 50 ticks."""
+        sim = SimCluster([SimJob("j", curve, 1, 8, nodes=start,
+                                 noise=0.01)],
+                         tick_s=5.0, downtime_s=1.2, seed=0)
+        out = run_policy(sim, make_policy(), ticks=150, settle_ticks=50)
+        job = out["jobs"]["j"]
+        assert job["gap_nodes"] <= 1, (name, job)
+        assert job["post_convergence_resizes"] == 0, (name, job)
+
+    def test_no_oscillation_on_noisy_flat_curve(self):
+        """Hysteresis: 2% multiplicative noise on a flat curve must not
+        produce grow/shrink flapping — across seeds, the policy walks
+        down to min once and then never resizes again."""
+        for seed in range(6):
+            sim = SimCluster([SimJob("j", flat(100), 1, 8, nodes=4,
+                                     noise=0.02)],
+                             tick_s=5.0, downtime_s=1.2, seed=seed)
+            out = run_policy(sim, make_policy(), ticks=250,
+                             settle_ticks=100)
+            job = out["jobs"]["j"]
+            assert job["final_nodes"] == 1, (seed, job)
+            assert job["post_convergence_resizes"] == 0, (seed, job)
+            # exploration is bounded: 4 -> 5 probe, then down to 1
+            assert job["resizes"] <= 5, (seed, job)
+
+    def test_cooldown_spaces_resizes(self):
+        """No two actuated resizes for one job closer than cooldown."""
+        cooldown = 20.0
+        sim = SimCluster([SimJob("j", concave(100, 0.5), 1, 8, nodes=1,
+                                 noise=0.0)],
+                         tick_s=5.0, downtime_s=1.0, seed=0)
+        run_policy(sim, make_policy(cooldown_s=cooldown, horizon_s=60.0),
+                   ticks=100)
+        ticks = sim.jobs["j"].resize_ticks
+        assert len(ticks) >= 2
+        gaps = [(b - a) * sim.tick_s for a, b in zip(ticks, ticks[1:])]
+        assert min(gaps) >= cooldown, gaps
+
+    def test_amortization_blocks_unpayable_resize(self):
+        """A downtime larger than the decision horizon can never pay for
+        itself — the policy must hold forever, not resize."""
+        sim = SimCluster([SimJob("j", linear(100), 1, 8, nodes=2,
+                                 noise=0.0)],
+                         tick_s=5.0, downtime_s=100.0, seed=0)
+        out = run_policy(sim, make_policy(cooldown_s=15.0,
+                                          horizon_s=60.0), ticks=60)
+        assert sim.jobs["j"].resizes == 0
+        assert out["downtime_paid_s"] == 0.0
+
+    def test_restore_resumes_cooldown_and_curve(self):
+        """Journal replay: a restored policy knows the curve and does
+        not re-resize inside the predecessor's cooldown window."""
+        src = make_policy()
+        now = 1000.0
+        view = JobView("j", 2, 200.0, 1, 8, downtime_s=1.0)
+        entries = [
+            {"job_id": "j", "world_size": 1, "throughput": 100.0,
+             "fresh": True, "action": "hold", "ts": now - 40},
+            {"job_id": "j", "world_size": 2, "throughput": 200.0,
+             "fresh": True, "action": "resize", "ts": now - 5},
+        ]
+        src.restore(entries)
+        assert src.model("j").observed(1) == 100.0
+        (prop,) = src.decide([view], now)
+        assert not prop.is_resize and prop.reason == "cooldown"
+        # past the cooldown the restored curve drives a real decision
+        (prop,) = src.decide([view], now + 60.0)
+        assert prop.is_resize and prop.reason == "probe-up"
+
+
+class TestFairSharePolicy:
+    def test_budget_conservation_and_minmax(self):
+        """Planned allocations always sum to min(budget, sum(max)) when
+        the budget covers the mins, and honor every job's min/max."""
+        for budget in (4, 6, 9, 12, 24):
+            pol = FairSharePolicy(budget, cooldown_s=15.0,
+                                  horizon_s=60.0)
+            views = [JobView("a", 2, 100.0, 1, 8),
+                     JobView("b", 2, 50.0, 2, 4),
+                     JobView("c", 1, 10.0, 1, 6)]
+            for v in views:  # teach each model one point
+                pol.model(v.job_id).observe(v.world_size, v.throughput)
+            alloc = pol.plan(views)
+            cap = sum(v.max_nodes for v in views)
+            assert sum(alloc.values()) == min(budget, cap), alloc
+            for v in views:
+                assert v.min_nodes <= alloc[v.job_id] <= v.max_nodes, \
+                    (budget, alloc)
+
+    def test_budget_never_exceeded_mid_flight(self):
+        """Shrink-before-grow: across a whole simulated run the live
+        node total never transiently exceeds the budget."""
+        budget = 8
+        jobs = [SimJob("lin", linear(50), 1, 8, nodes=4, noise=0.01),
+                SimJob("fl", flat(100), 1, 8, nodes=4, noise=0.01)]
+        sim = SimCluster(jobs, tick_s=5.0, downtime_s=1.2, seed=0)
+        pol = FairSharePolicy(budget, cooldown_s=15.0, horizon_s=60.0)
+        for _ in range(100):
+            views = sim.tick()
+            for prop in pol.decide(views, sim.now):
+                if prop.is_resize:
+                    actual = sim.resize(prop.job_id, prop.desired)
+                    pol.notify_resized(prop.job_id, actual, sim.now)
+            assert sum(j.nodes for j in sim.jobs.values()) <= budget
+
+    def test_prefers_higher_marginal_job(self):
+        """A linear-scaling job outbids a flat one for the headroom and
+        the split matches the true-curve oracle."""
+        jobs = [SimJob("lin", linear(50), 1, 8, nodes=2, noise=0.01),
+                SimJob("fl", flat(100), 1, 8, nodes=2, noise=0.01)]
+        sim = SimCluster(jobs, tick_s=5.0, downtime_s=1.2, seed=0)
+        pol = FairSharePolicy(8, cooldown_s=15.0, horizon_s=60.0)
+        out = run_policy(sim, pol, ticks=200, settle_ticks=50)
+        oracle = sim.oracle_fair_share(8)
+        for job_id, target in oracle.items():
+            assert abs(out["jobs"][job_id]["final_nodes"] - target) <= 1
+        assert out["post_convergence_resizes"] == 0
+
+
+# -- controller tier -------------------------------------------------------
+
+
+def seed_job(store, job="j1", world=2, rate=120.0, now=None):
+    """A live job in the store: rank claims + cluster + fresh util."""
+    from edl_tpu.collective.cluster import Cluster, Pod
+    from edl_tpu.collective.register import cluster_key, rank_key
+    from edl_tpu.coord.collector import util_key
+    now = time.time() if now is None else now
+    pods = []
+    for i in range(world):
+        pod_id = f"pod{i}"
+        store.put(rank_key(job, i),
+                  Pod(pod_id=pod_id, addr=f"10.0.0.{i}", n_devices=1,
+                      claimed_rank=i, rank=i).to_json(),
+                  lease=store.lease_grant(30.0))
+        store.put(util_key(job, pod_id),
+                  json.dumps({"pod_id": pod_id, "step": 10,
+                              "examples_per_sec": rate / world,
+                              "world_size": world,
+                              "published_unix": now}),
+                  lease=store.lease_grant(30.0))
+        pods.append(Pod(pod_id=pod_id, addr=f"10.0.0.{i}", rank=i))
+    store.put(cluster_key(job),
+              Cluster(job_id=job, version=world, pods=pods).to_json())
+
+
+def make_controller(store, state, **kw):
+    """Controller actuating straight into a JobState (no HTTP)."""
+    kw.setdefault("config", ScalerConfig(interval=0.1, cooldown_s=30.0,
+                                         downtime_s=1.0,
+                                         staleness_s=30.0,
+                                         min_nodes=state.min_nodes,
+                                         max_nodes=state.max_nodes,
+                                         leader_ttl=0.5))
+    kw.setdefault("actuate",
+                  lambda _job, desired: state.resize(desired))
+    policy = kw.pop("policy", None) or make_policy(cooldown_s=30.0)
+    return ScalerController(store, [state.job_id], policy, **kw)
+
+
+class TestControllerIntegration:
+    def test_collector_to_jobserver_tick(self, tmp_path):
+        """One store-backed tick end to end: Collector snapshot ->
+        ThroughputPolicy -> HTTP /resize on a real JobServer, with the
+        decision journaled to the store AND the JSON-lines file."""
+        from edl_tpu.collective.job_server import (JobServer, JobState,
+                                                   get_job)
+        store = InMemStore()
+        seed_job(store, world=2)
+        state = JobState("j1", 1, 4, desired=2)
+        server = JobServer(state, port=0).start()
+        journal_file = tmp_path / "journal.jsonl"
+        try:
+            ctl = ScalerController(
+                store, ["j1"], make_policy(),
+                config=ScalerConfig(cooldown_s=30.0, downtime_s=1.0,
+                                    staleness_s=30.0),
+                job_server=f"127.0.0.1:{server.port}",
+                journal_path=str(journal_file), elect=False)
+            entries = ctl.tick()
+            assert len(entries) == 1
+            (entry,) = entries
+            # one fresh size known -> the policy probes one node up
+            assert entry["action"] == "resize"
+            assert entry["reason"] == "probe-up"
+            assert entry["current"] == 2 and entry["desired"] == 3
+            assert entry["throughput"] == pytest.approx(120.0)
+            assert get_job(f"127.0.0.1:{server.port}")[
+                "desired_nodes"] == 3
+            # journaled in the store (successor's replay medium)...
+            recs, _ = store.get_prefix(journal_prefix("j1"))
+            assert [json.loads(r.value)["action"] for r in recs] \
+                == ["resize"]
+            # ...and as a JSON line for the operator
+            lines = journal_file.read_text().strip().splitlines()
+            assert json.loads(lines[-1])["desired"] == 3
+            # the very next tick honors the cooldown it just started
+            (entry2,) = ctl.tick()
+            assert entry2["action"] == "hold"
+            assert entry2["reason"] in ("cooldown",
+                                        "settling-after-resize",
+                                        "resize-in-flight")
+            ctl.stop()
+        finally:
+            server.stop()
+
+    def test_dry_run_never_actuates(self):
+        from edl_tpu.collective.job_server import JobState
+        store = InMemStore()
+        seed_job(store, world=2)
+        state = JobState("j1", 1, 4, desired=2)
+        calls = []
+        ctl = make_controller(
+            store, state, dry_run=True, elect=False,
+            actuate=lambda job, desired: calls.append(desired))
+        (entry,) = ctl.tick()
+        assert entry["action"] == "dry-run"
+        assert entry["desired"] == 3
+        assert not calls and state.desired == 2
+        ctl.stop()
+
+    def test_stale_and_mismatched_utilization_is_ignored(self):
+        """Records older than staleness_s or published under a different
+        world_size must not feed the model."""
+        from edl_tpu.coord.collector import util_key
+        store = InMemStore()
+        now = time.time()
+        seed_job(store, world=2, rate=100.0, now=now)
+        # pod0's record goes stale; pod1's is from the pre-resize world
+        store.put(util_key("j1", "pod0"),
+                  json.dumps({"examples_per_sec": 50.0, "world_size": 2,
+                              "published_unix": now - 3600}))
+        store.put(util_key("j1", "pod1"),
+                  json.dumps({"examples_per_sec": 50.0, "world_size": 9,
+                              "published_unix": now}))
+        ctl = ScalerController(store, ["j1"], make_policy(),
+                               config=ScalerConfig(staleness_s=30.0),
+                               elect=False)
+        view = ctl.observe("j1")
+        assert not view.fresh and view.throughput == 0.0
+        ctl.stop()
+
+    def test_leader_election_handoff_resumes_from_journal(self):
+        """Exactly-one-scaler + takeover: controller A (leader) makes a
+        resize and dies WITHOUT resigning; B takes over after lease
+        expiry, replays A's journal, and honors A's cooldown instead of
+        double-resizing."""
+        from edl_tpu.collective.job_server import JobState
+        store = InMemStore()
+        seed_job(store, world=2)
+        state = JobState("j1", 1, 4, desired=2)
+        a = make_controller(store, state, owner="A")
+        b = make_controller(store, state, owner="B")
+        try:
+            assert a.election.campaign(timeout=5.0)
+            entries = a.tick()
+            assert entries and entries[0]["action"] == "resize"
+            assert state.desired == 3
+            # B cannot act while A holds the lease
+            assert b.tick() == []
+            # A dies: keepalive stops, lease expires (never resigned)
+            hold = a.election.lock._hold
+            hold.stop.set()
+            assert b.election.campaign(timeout=10.0)
+            assert b.is_leader()
+            # B's first decision replays A's journal: inside A's
+            # cooldown it must hold, not resize again
+            seed_job(store, world=3)  # world caught up with desired
+            (entry,) = b.tick()
+            assert entry["action"] == "hold"
+            assert entry["reason"] in ("cooldown",
+                                       "settling-after-resize")
+            assert state.desired == 3
+            assert entry["leader"] == "B"
+            # seq continues where A left off (one shared journal)
+            recs, _ = store.get_prefix(journal_prefix("j1"))
+            seqs = [json.loads(r.value)["seq"] for r in recs]
+            assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        finally:
+            a.stop()
+            b.stop()
+
+
+class TestDecisionJournal:
+    def test_retention_keeps_newest(self):
+        store = InMemStore()
+        journal = DecisionJournal(store, "jx", keep=5)
+        for i in range(12):
+            journal.append({"job_id": "jx", "action": "hold", "i": i})
+        tail = journal.tail()
+        assert len(tail) <= 6  # keep + the in-flight append window
+        assert tail[-1]["i"] == 11
+        # a new journal instance continues the sequence
+        journal2 = DecisionJournal(store, "jx", keep=5)
+        entry = journal2.append({"job_id": "jx", "action": "hold"})
+        assert entry["seq"] == 12

@@ -1,0 +1,110 @@
+"""Policy x curve-shape sweep for the autoscaler decision plane.
+
+Runs every (policy, scaling-curve) pair on the deterministic
+`SimCluster` and prints ONE markdown table: how many ticks until the
+allocation converged, how far from the oracle it landed, how many
+resizes it spent, the stop-resume downtime it paid (at the measured
+`elastic_downtime_s` price), and whether it stayed put afterwards.
+Tuning `--gain-threshold` / `--cooldown` for a deployment is one
+command: widen the threshold until post-convergence resizes hit 0,
+then shrink cooldown until the downtime column says stop.
+
+  python tools/scaler_bench.py --downtime-s 1.2 --ticks 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/scaler_bench.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
+
+
+def curve_menu():
+    from edl_tpu.scaler.simulator import concave, flat, knee, linear
+    return (("concave a=0.3", concave(100.0, 0.3), 1),
+            ("concave a=0.6", concave(100.0, 0.6), 2),
+            ("flat", flat(100.0), 4),
+            ("knee k=4", knee(100.0, 4), 7),
+            ("linear", linear(100.0), 1))
+
+
+def run_throughput(curve, start, args):
+    from edl_tpu.scaler.policy import ThroughputPolicy
+    from edl_tpu.scaler.simulator import SimCluster, SimJob, run_policy
+    sim = SimCluster([SimJob("j", curve, 1, args.max_nodes, nodes=start,
+                             noise=args.noise)],
+                     tick_s=args.tick_s, downtime_s=args.downtime_s,
+                     seed=args.seed)
+    policy = ThroughputPolicy(gain_threshold=args.gain_threshold,
+                              cooldown_s=args.cooldown,
+                              horizon_s=args.horizon)
+    out = run_policy(sim, policy, ticks=args.ticks, settle_ticks=50)
+    return out["jobs"]["j"]
+
+
+def run_fairshare(curve, start, args):
+    """The swept curve shares a budget with one fixed linear job — the
+    competitive setting FairShare exists for."""
+    from edl_tpu.scaler.policy import FairSharePolicy
+    from edl_tpu.scaler.simulator import (SimCluster, SimJob, linear,
+                                          run_policy)
+    jobs = [SimJob("j", curve, 1, args.max_nodes, nodes=start,
+                   noise=args.noise),
+            SimJob("rival", linear(50.0), 1, args.max_nodes, nodes=1,
+                   noise=args.noise)]
+    sim = SimCluster(jobs, tick_s=args.tick_s,
+                     downtime_s=args.downtime_s, seed=args.seed)
+    policy = FairSharePolicy(args.budget,
+                             gain_threshold=args.gain_threshold,
+                             cooldown_s=args.cooldown,
+                             horizon_s=args.horizon)
+    out = run_policy(sim, policy, ticks=args.ticks, settle_ticks=50)
+    job = dict(out["jobs"]["j"])
+    job["oracle_nodes"] = sim.oracle_fair_share(args.budget)["j"]
+    job["gap_nodes"] = abs(job["final_nodes"] - job["oracle_nodes"])
+    return job
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tools/scaler_bench.py")
+    parser.add_argument("--ticks", type=int, default=200)
+    parser.add_argument("--tick-s", type=float, default=5.0)
+    parser.add_argument("--downtime-s", type=float, default=1.2,
+                        help="per-resize stop-resume price (bench.py "
+                             "elastic_downtime_s)")
+    parser.add_argument("--cooldown", type=float, default=15.0)
+    parser.add_argument("--horizon", type=float, default=60.0)
+    parser.add_argument("--gain-threshold", type=float, default=0.05)
+    parser.add_argument("--noise", type=float, default=0.01)
+    parser.add_argument("--max-nodes", type=int, default=8)
+    parser.add_argument("--budget", type=int, default=10,
+                        help="fairshare: shared node budget")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    print(f"ticks={args.ticks} tick={args.tick_s:.0f}s "
+          f"downtime={args.downtime_s}s cooldown={args.cooldown:.0f}s "
+          f"eps={args.gain_threshold} noise={args.noise} "
+          f"(converge = tick of the LAST resize; post = resizes in the "
+          f"trailing 50-tick window, the oscillation alarm)")
+    print("| policy | curve | start | final | oracle | gap | converge "
+          "(ticks) | resizes | downtime s | post |")
+    print("|---|---|---|---|---|---|---|---|---|---|")
+    for policy_name, runner in (("throughput", run_throughput),
+                                ("fairshare", run_fairshare)):
+        for curve_name, curve, start in curve_menu():
+            r = runner(curve, start, args)
+            print(f"| {policy_name} | {curve_name} | {start} "
+                  f"| {r['final_nodes']} | {r['oracle_nodes']} "
+                  f"| {r['gap_nodes']} | {r['decisions_to_converge']} "
+                  f"| {r['resizes']} | {r['downtime_paid_s']} "
+                  f"| {r['post_convergence_resizes']} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
