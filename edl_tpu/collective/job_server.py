@@ -41,6 +41,11 @@ class JobState:
             desired = max_nodes
         self.desired = max(min_nodes, min(max_nodes, desired))
         self._rng = random.Random(seed)
+        # every desired_nodes change this server actually served, in
+        # order — the audit trail demos/tests cross-check against the
+        # scaler's decision journal (a resize NOT in the journal is a
+        # scaler acting outside its own observability surface)
+        self.resize_log: list[dict] = []
         # RLock: resize()/random_resize() return snapshot() while holding it.
         self._lock = threading.RLock()
 
@@ -53,8 +58,13 @@ class JobState:
     def resize(self, desired: int) -> dict:
         with self._lock:
             clamped = not (self.min_nodes <= desired <= self.max_nodes)
+            prev = self.desired
             self.desired = max(self.min_nodes,
                                min(self.max_nodes, desired))
+            self.resize_log.append({"from": prev, "to": self.desired,
+                                    "requested": desired,
+                                    "clamped": clamped,
+                                    "source": "resize"})
             if clamped:
                 # loud, not silent: the scaler journals the response, so
                 # a clamp must be visible there and in this log
@@ -74,7 +84,11 @@ class JobState:
         with self._lock:
             choices = [n for n in range(self.min_nodes, self.max_nodes + 1)
                        if n != self.desired] or [self.desired]
+            prev = self.desired
             self.desired = self._rng.choice(choices)
+            self.resize_log.append({"from": prev, "to": self.desired,
+                                    "requested": self.desired,
+                                    "clamped": False, "source": "fault"})
             log.info("fault injection: desired_nodes -> %d", self.desired)
             return self.snapshot()
 

@@ -17,8 +17,10 @@ Two halves:
   ``{epoch, step, samples_seen, examples_per_sec, world_size,
   generation, published_unix}`` to the leased key
   ``/{job}/util/{pod_id}``; the lease makes staleness self-cleaning (a
-  dead trainer's utilization disappears after TTL). TrainLoop installs
-  one automatically when running under the elastic launcher
+  dead trainer's utilization disappears after TTL). ``world_size`` is
+  the ELASTIC world (launcher pod count, EDL_TPU_WORLD_SIZE) — the
+  unit the scaler allocates in — not the device world. TrainLoop
+  installs one automatically when running under the elastic launcher
   (EDL_TPU_RANK set) unless EDL_TPU_PUBLISH_UTIL=0.
 - `Collector` — scheduler-side. Snapshots a job (live rank claims,
   published cluster generation, per-pod utilization) + any service
@@ -73,7 +75,8 @@ class UtilizationPublisher:
 
     def __init__(self, store: Store, job_id: str, pod_id: str, *,
                  rank: int = -1, ttl: float = 15.0,
-                 min_interval: float = 1.0, generation: int | None = None):
+                 min_interval: float = 1.0, generation: int | None = None,
+                 world_size: int | None = None):
         self.store = store
         self.job_id = job_id
         self.pod_id = pod_id
@@ -83,6 +86,14 @@ class UtilizationPublisher:
         # cluster generation this trainer was launched into (the scaler
         # correlates a rate with the allocation that produced it)
         self.generation = generation
+        # the ELASTIC world — launcher pod count (EDL_TPU_WORLD_SIZE),
+        # the same unit as Cluster.world_size and the scaler's node
+        # allocations. NOT loop.status.world_size, which is the device
+        # world (jax.device_count() / mesh dp size): with >1 device per
+        # pod the two differ and the scaler's pre-resize filter would
+        # drop every record. None = unknown (standalone hook): the doc
+        # carries null and the scaler skips the cross-world filter.
+        self.world_size = world_size
         # `published_unix` must be monotonic per pod even across clock
         # hiccups: the scaler's staleness check subtracts it from now()
         self._pub_unix = 0.0
@@ -124,10 +135,12 @@ class UtilizationPublisher:
             log.warning("utilization publisher disabled (store "
                         "unreachable: %s)", exc)
             return None
+        world = os.environ.get("EDL_TPU_WORLD_SIZE", "")
         pub = cls(store, job_id, pod_id,
                   rank=int(os.environ.get("EDL_TPU_RANK", "-1")),
                   generation=int(os.environ.get(
-                      "EDL_TPU_CLUSTER_VERSION", "0")) or None)
+                      "EDL_TPU_CLUSTER_VERSION", "0")) or None,
+                  world_size=int(world) if world else None)
         pub._owns_store = True
         return pub
 
@@ -162,16 +175,15 @@ class UtilizationPublisher:
                 else 0.0
             # scaler contract: `published_unix` (monotonic non-decreasing
             # staleness anchor — lease TTL alone only bounds death, not
-            # stale rates) + `world_size` (the allocation this rate was
-            # measured UNDER, so pre-resize records are filterable).
+            # stale rates) + `world_size` (the POD-COUNT allocation this
+            # rate was measured under — Cluster.world_size's unit — so
+            # pre-resize records are filterable against the live world).
             self._pub_unix = max(time.time(), self._pub_unix + 1e-4)
             doc = {"pod_id": self.pod_id, "rank": self.rank,
                    "epoch": int(epoch), "step": int(step),
                    "samples_seen": samples,
                    "examples_per_sec": round(max(rate, 0.0), 2),
-                   "world_size": int(getattr(
-                       getattr(loop, "status", None), "world_size", 0)
-                       or 0) if loop is not None else 0,
+                   "world_size": self.world_size,
                    "generation": self.generation,
                    "published_unix": round(self._pub_unix, 4),
                    "ts": time.time()}

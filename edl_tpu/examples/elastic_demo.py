@@ -61,8 +61,11 @@ def make_data(epoch: int, rank: int, world: int, steps: int, batch: int):
 def run_scaler_demo(args) -> int:
     """Controller-driven elasticity end-to-end on this host: store +
     JobServer + JobClient-spawned launcher pods + ScalerController, all
-    wired to each other; returns non-zero if the job never completes or
-    a resize escaped the decision journal."""
+    wired to each other; returns non-zero if the job never completes,
+    a resize the JobServer served escaped the decision journal (the
+    served resize_log and the journal's applied resizes must match),
+    or the scaler never observed fresh utilization while the node
+    range left it room to act (the silently-doing-nothing failure)."""
     import os
     import shutil
     import subprocess
@@ -147,21 +150,47 @@ def run_scaler_demo(args) -> int:
     except OSError:
         pass
     resizes = [e for e in entries if e["action"] == "resize"]
+    # Cross-check the docstring's promise: every resize the JobServer
+    # actually served must have a matching journal entry (same applied
+    # values, same order). `final_desired` moving off the initial `lo`
+    # with an empty journal is the same escape.
+    served = [s["to"] for s in state.resize_log]
+    journaled = [e["applied"] if e.get("applied") is not None
+                 else e["desired"] for e in resizes]
+    escaped = served != journaled or \
+        state.desired != (served[-1] if served else lo)
+    # A scaler that silently does nothing (e.g. every record filtered
+    # as pre-resize) never sees fresh utilization: with room to act
+    # (hi > lo) that is a failure, not a quiet pass.
+    fresh_seen = any(e.get("fresh") for e in entries)
+    silent = hi > lo and not fresh_seen
     summary = {"complete": complete, "decisions": len(entries),
                "resizes": [{"tick": e["seq"], "from": e["current"],
                             "to": e["desired"], "reason": e["reason"]}
                            for e in resizes],
+               "served_resizes": state.resize_log,
+               "journal_matches_served": not escaped,
+               "fresh_utilization_seen": fresh_seen,
                "final_desired": state.desired,
                "journal": journal_path if args.journal else None}
-    log.info("scaler demo done: complete=%s decisions=%d resizes=%d",
-             complete, len(entries), len(resizes))
+    log.info("scaler demo done: complete=%s decisions=%d resizes=%d "
+             "served=%d journal_matches_served=%s fresh_seen=%s",
+             complete, len(entries), len(resizes), len(served),
+             not escaped, fresh_seen)
+    if escaped:
+        log.error("resize escaped the decision journal: served=%s "
+                  "journaled=%s final_desired=%d", served, journaled,
+                  state.desired)
+    if silent:
+        log.error("scaler never observed fresh utilization (nodes %d:%d"
+                  ") — the closed loop is not closing", lo, hi)
     # machine-readable (mirrors the ckpt_stats= convention bench.py reads)
     print("scaler_summary=" + json.dumps(summary), flush=True)
     if args.journal is None:
         shutil.rmtree(tmp, ignore_errors=True)
     else:
         shutil.rmtree(os.path.join(tmp, "ckpt"), ignore_errors=True)
-    return 0 if complete else 1
+    return 0 if complete and not escaped and not silent else 1
 
 
 def main(argv=None) -> int:

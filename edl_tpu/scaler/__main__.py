@@ -8,7 +8,8 @@
 
 Flags are flag-else-env (`EDL_TPU_SCALER_*`; utils/config overlay).
 `--policy fairshare --budget N` scales several `--job`s against one
-node budget by marginal throughput.
+node budget by marginal throughput — store-only (a `--server` holds a
+single job's state, so it cannot be combined with multiple `--job`).
 """
 
 from __future__ import annotations
@@ -57,6 +58,13 @@ def main(argv=None) -> int:
         parser.error("at least one --job is required")
     if args.policy == "fairshare" and args.budget is None:
         parser.error("--policy fairshare requires --budget")
+    if args.server and len(args.jobs) > 1:
+        # one JobServer holds ONE job's state: sharing it would read the
+        # same min/max/desired for every job and land every /resize on
+        # the same JobState, the jobs overwriting each other each tick
+        parser.error("--server actuates a single job; with multiple "
+                     "--job run store-only (omit --server, decisions "
+                     "are journaled) or one scaler per job")
 
     overrides = {k: v for k, v in (
         ("interval", args.interval), ("cooldown_s", args.cooldown),

@@ -158,6 +158,12 @@ class ScalerController:
         self.jobs = list(jobs)
         self.policy = policy
         self.config = config or ScalerConfig()
+        if job_server is not None and len(self.jobs) > 1:
+            # one JobServer holds ONE job's state: sharing it would read
+            # the same min/max/desired for every job and land every
+            # /resize on the same JobState (jobs overwriting each other)
+            raise ValueError("job_server actuates a single job; run "
+                             "store-only or one controller per job")
         self.job_server = job_server
         self._actuate_fn = actuate
         self.dry_run = dry_run
@@ -211,6 +217,9 @@ class ScalerController:
             if published is None \
                     or now - float(published) > self.config.staleness_s:
                 continue  # stale: a dead pod's lease hasn't expired yet
+            # both sides are POD counts: the publisher's world_size is
+            # the elastic world (EDL_TPU_WORLD_SIZE), `world` is
+            # Cluster.world_size — never the per-pod device count
             pod_world = util.get("world_size")
             if pod_world is not None and world and int(pod_world) != world:
                 continue  # pre-resize record: wrong allocation's rate

@@ -290,8 +290,10 @@ class FairSharePolicy(_PolicyBase):
 
     Water-filling: every job starts at its `min_nodes`; each remaining
     budget node goes to the job whose model predicts the largest gain
-    from one more node (unexplored jobs predict optimistically, so they
-    attract exploration). Proposals then reconcile the plan against the
+    from one more node (unexplored jobs bid the best observed per-node
+    rate across jobs — optimistic on the measured scale, so they
+    attract exploration instead of being starved by explored jobs'
+    absolute marginals). Proposals then reconcile the plan against the
     live allocations shrink-before-grow: grows are admitted only while
     the post-shrink total stays within budget, so the cluster never
     transiently exceeds it even when cooldowns stagger the actuations.
@@ -309,6 +311,15 @@ class FairSharePolicy(_PolicyBase):
             grant = min(v.min_nodes, max(left, 0))
             alloc[v.job_id] = grant
             left -= grant
+        # Exploration bonus for jobs with NO observations yet, in the
+        # same absolute examples/sec unit as explored jobs' marginal
+        # gains: the best observed per-node rate across all jobs (a
+        # constant like 1.0 would starve unexplored jobs whenever the
+        # measured curves live at ~100 ex/s). No observations anywhere
+        # -> every job is unexplored and any positive constant ties.
+        rates = [self.model(v.job_id).observed(n) / n
+                 for v in views for n in self.model(v.job_id).known()]
+        explore = max([r for r in rates if r > 0], default=1.0)
         while left > 0:
             best_job, best_gain = None, 0.0
             for v in views:
@@ -317,9 +328,12 @@ class FairSharePolicy(_PolicyBase):
                     continue
                 model = self.model(v.job_id)
                 t0, t1 = model.predict(n), model.predict(n + 1)
-                # unexplored job: unit-linear optimism (explore it)
+                # unexplored job: optimistic per-node-rate bonus, decayed
+                # by the tentative allocation so several unexplored jobs
+                # round-robin probe nodes instead of the first in view
+                # order absorbing the whole remaining budget
                 gain = (t1 - t0) if t0 is not None and t1 is not None \
-                    else 1.0
+                    else explore / (n + 1.0)
                 if best_job is None or gain > best_gain:
                     best_job, best_gain = v.job_id, gain
             if best_job is None:

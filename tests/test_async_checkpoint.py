@@ -350,11 +350,22 @@ def test_loop_async_saves_match_sync_saves(tmp_path):
     assert async_loop.ckpt_saves == sync_loop.ckpt_saves
     stats = async_loop.ckpt_stats()
     assert stats["ckpt_saves_async"] > 0 and stats["ckpt_errors"] == 0
-    sync_versions = sorted(os.listdir(tmp_path / "sync"))
-    assert sorted(os.listdir(tmp_path / "async")) == sync_versions
-    last = sync_versions[-1]
-    assert (tmp_path / "sync" / last / "state.msgpack").read_bytes() == \
-        (tmp_path / "async" / last / "state.msgpack").read_bytes()
+    # On a loaded host the writer may legally coalesce back-to-back
+    # saves (a snapshot superseded before its write starts), so the
+    # version LISTS can differ; every save must still be accounted for
+    # as either a write or a supersede...
+    assert stats["ckpt_writes"] + stats["ckpt_superseded"] == \
+        stats["ckpt_saves_async"]
+
+    # ...and the NEWEST checkpoint — what a restore would see — must be
+    # byte-identical to the sync run's.
+    def newest(subdir):
+        versions = sorted(os.listdir(tmp_path / subdir),
+                          key=lambda v: int(v.rsplit("-", 1)[-1]))
+        return tmp_path / subdir / versions[-1]
+
+    assert (newest("async") / "state.msgpack").read_bytes() == \
+        (newest("sync") / "state.msgpack").read_bytes()
 
 
 def test_loop_surfaces_writer_failure(tmp_path):

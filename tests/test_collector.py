@@ -146,16 +146,19 @@ class TestUtilizationPublisher:
     def test_doc_carries_scaler_contract_fields(self):
         """The autoscaler's staleness + correlation anchors: a
         monotonic `published_unix` and the world size the rate was
-        measured under (edl_tpu/scaler reads both)."""
+        measured under (edl_tpu/scaler reads both). `world_size` is the
+        ELASTIC world (pod count, what the launcher exports) — NOT the
+        device world in loop.status — because the scaler compares it
+        against Cluster.world_size, which counts pods."""
 
         class _Loop:
             class status:
                 samples_seen = 128
-                world_size = 4
+                world_size = 8   # device world (2 pods x 4 devices)
 
         store = InMemStore()
         pub = UtilizationPublisher(store, "j1", "podA", min_interval=0.0,
-                                   generation=7)
+                                   generation=7, world_size=2)
         loop = _Loop()
         stamps = []
         for step in (1, 2, 3):
@@ -164,10 +167,40 @@ class TestUtilizationPublisher:
             assert pub.flush()
             doc = json.loads(store.get(util_key("j1", "podA")).value)
             stamps.append(doc["published_unix"])
-            assert doc["world_size"] == 4
+            assert doc["world_size"] == 2   # pod count, never 8
             assert doc["generation"] == 7
         assert stamps == sorted(stamps)
         assert len(set(stamps)) == 3  # strictly increasing
+        pub.stop()
+
+    def test_world_size_unknown_published_as_null(self):
+        """A standalone hook (no launcher context) doesn't know the
+        elastic world: the doc carries null, which the scaler treats as
+        'cannot correlate' rather than filtering the record out."""
+        store = InMemStore()
+        pub = UtilizationPublisher(store, "j1", "podA", min_interval=0.0)
+        loop = self._Loop()
+        pub(loop, 0, 1, {})
+        assert pub.flush()
+        doc = json.loads(store.get(util_key("j1", "podA")).value)
+        assert doc["world_size"] is None
+        pub.stop()
+
+    def test_from_env_reads_elastic_world(self, monkeypatch):
+        """from_env wires EDL_TPU_WORLD_SIZE (the launcher's pod count)
+        into the published world_size."""
+        import edl_tpu.coord.redis_store as redis_store
+        store = InMemStore()
+        monkeypatch.setattr(redis_store, "connect_store",
+                            lambda ep: store)
+        monkeypatch.setenv("EDL_TPU_RANK", "0")
+        monkeypatch.setenv("EDL_TPU_WORLD_SIZE", "3")
+        monkeypatch.setenv("EDL_TPU_STORE_ENDPOINTS", "127.0.0.1:1")
+        monkeypatch.setenv("EDL_TPU_JOB_ID", "jenv")
+        monkeypatch.setenv("EDL_TPU_POD_ID", "podE")
+        pub = UtilizationPublisher.from_env()
+        assert pub is not None and pub.world_size == 3
+        pub._owns_store = False  # InMemStore: nothing to close
         pub.stop()
 
     def test_store_failure_never_raises(self):

@@ -101,6 +101,24 @@ def test_resize_clamp_is_visible():
         logger.removeHandler(capture)
 
 
+def test_resize_log_records_every_served_change():
+    """The audit trail the scaler demo cross-checks against the
+    decision journal: one entry per served resize, fault injections
+    tagged with their source."""
+    state = JobState("j1", 1, 4, desired=2, seed=7)
+    state.resize(3)
+    state.resize(99)   # clamped to 4
+    state.random_resize()
+    assert [e["from"] for e in state.resize_log] == [2, 3, 4]
+    assert state.resize_log[0] == {"from": 2, "to": 3, "requested": 3,
+                                   "clamped": False, "source": "resize"}
+    assert state.resize_log[1]["to"] == 4
+    assert state.resize_log[1]["clamped"] is True
+    assert state.resize_log[1]["requested"] == 99
+    assert state.resize_log[2]["source"] == "fault"
+    assert state.resize_log[-1]["to"] == state.desired
+
+
 def test_fault_injection_changes_desired():
     state = JobState("j1", 1, 4, desired=2, seed=7)
     server = JobServer(state, port=0, time_interval_to_change=0.1).start()

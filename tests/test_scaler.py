@@ -146,6 +146,22 @@ class TestFairSharePolicy:
                     pol.notify_resized(prop.job_id, actual, sim.now)
             assert sum(j.nodes for j in sim.jobs.values()) <= budget
 
+    def test_unexplored_job_attracts_probe_nodes(self):
+        """Scale-aware exploration: with an explored job measured in the
+        ~100 examples/sec range (absolute marginal gains of several
+        ex/s), a job with NO observations must still win probe nodes —
+        the old constant 1.0 bonus starved it until every explored
+        marginal dropped below 1.0 ex/s."""
+        pol = FairSharePolicy(8, cooldown_s=15.0, horizon_s=60.0)
+        # diminishing curve, but absolute marginals still > 1.0 ex/s
+        pol.model("old").observe(2, 100.0)
+        pol.model("old").observe(4, 110.0)
+        views = [JobView("old", 4, 110.0, 1, 8),
+                 JobView("new", 1, 0.0, 1, 8, fresh=False)]
+        alloc = pol.plan(views)
+        assert alloc["new"] > 1, alloc
+        assert sum(alloc.values()) == 8, alloc
+
     def test_prefers_higher_marginal_job(self):
         """A linear-scaling job outbids a flat one for the headroom and
         the split matches the true-curve oracle."""
@@ -282,6 +298,48 @@ class TestControllerIntegration:
         view = ctl.observe("j1")
         assert not view.fresh and view.throughput == 0.0
         ctl.stop()
+
+    def test_publisher_world_unit_matches_cluster(self):
+        """Regression (r11 review): the publisher's doc carries the
+        ELASTIC world (pod count) — observe() compares it against
+        Cluster.world_size, so publishing the device world would drop
+        every fresh record as 'pre-resize' whenever devices-per-pod
+        != 1 and the live loop would silently do nothing."""
+        from edl_tpu.coord.collector import UtilizationPublisher
+
+        class _Loop:
+            class status:
+                samples_seen = 0
+                world_size = 8   # device world: 2 pods x 4 devices
+
+        store = InMemStore()
+        seed_job(store, world=2)   # Cluster.world_size = 2 pods
+        pubs = []
+        for pod in ("pod0", "pod1"):
+            pub = UtilizationPublisher(store, "j1", pod,
+                                       min_interval=0.0, world_size=2)
+            pub(_Loop(), 0, 1, {})
+            assert pub.flush()
+            pubs.append(pub)
+        ctl = ScalerController(store, ["j1"], make_policy(),
+                               config=ScalerConfig(staleness_s=30.0),
+                               elect=False)
+        view = ctl.observe("j1")
+        assert view.fresh and view.world_size == 2
+        ctl.stop()
+        for pub in pubs:
+            pub.stop()
+
+    def test_cli_rejects_server_with_multiple_jobs(self, capsys):
+        """One JobServer holds one job's state: --server plus several
+        --job would alias every job onto the same JobState, so the CLI
+        refuses the combination up front."""
+        from edl_tpu.scaler.__main__ import main
+        with pytest.raises(SystemExit) as exc:
+            main(["--store", "127.0.0.1:1", "--job", "a", "--job", "b",
+                  "--server", "127.0.0.1:2"])
+        assert exc.value.code == 2
+        assert "single job" in capsys.readouterr().err
 
     def test_leader_election_handoff_resumes_from_journal(self):
         """Exactly-one-scaler + takeover: controller A (leader) makes a
