@@ -1068,6 +1068,71 @@ def bench_elastic_downtime(on_tpu: bool) -> dict:
                 child_stats.get("ckpt_save_stall_ms_mean")}
 
 
+def bench_elastic_downtime_p2p(on_tpu: bool) -> dict:
+    """Resize downtime under the p2p live state-migration plane: run
+    `elastic_demo --resize-p2p` (store + JobServer + 2 launcher pods,
+    scripted shrink + grow through /resize, self-audited) and read its
+    machine-readable summary.
+
+    - `elastic_downtime_p2p_s`: the WORST surviving-pod training gap
+      across the resizes — adoption observed at a step boundary ->
+      first completed step of the new generation. The p2p analogue of
+      the kill->first-step stop-resume number: a survivor never
+      respawns, re-imports, re-jits or restores, so the gap collapses
+      to one step boundary (vs `elastic_downtime_s` in this same
+      artifact, which pays all four on every resize).
+    - `resize_bytes_from_peers`: state the grown pod fetched from donor
+      memory over the tensor wire instead of reading disk.
+    The demo exits non-zero when any resize silently degraded to the
+    disk recipe, so a regression here fails the bench loudly.
+    """
+    import re
+    import shutil as _shutil
+    import subprocess
+    import sys
+    import tempfile as _tempfile
+
+    del on_tpu  # orchestration-plane measurement: CPU pods, hermetic
+    root = _tempfile.mkdtemp(prefix="edl-p2p-bench-")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU tunnel
+    env.update({"JAX_PLATFORMS": "cpu", "JAX_NUM_CPU_DEVICES": "1"})
+    out = {"elastic_downtime_p2p_s": None, "resize_bytes_from_peers": None,
+           "p2p_adoptions": None, "p2p_peer_restores": None,
+           "p2p_demo_ok": False}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "edl_tpu.examples.elastic_demo",
+             "--resize-p2p"],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        m = re.search(r"p2p_summary=(\{.*\})", proc.stdout)
+        if not m:
+            print("p2p downtime bench: no summary "
+                  f"(rc={proc.returncode})\n{proc.stdout[-2000:]}"
+                  f"\n{proc.stderr[-2000:]}", file=sys.stderr)
+            return out
+        summary = json.loads(m.group(1))
+        restore_s = [s for s in summary.get("peer_restore_s", [])
+                     if s is not None]
+        out.update({
+            "elastic_downtime_p2p_s": summary.get("elastic_downtime_p2p_s"),
+            "resize_bytes_from_peers":
+                summary.get("resize_bytes_from_peers"),
+            "p2p_adoptions": summary.get("adoptions"),
+            "p2p_peer_restores": summary.get("peer_restores"),
+            "p2p_peer_restore_s": (round(sorted(restore_s)[len(restore_s)
+                                                          // 2], 4)
+                                   if restore_s else None),
+            "p2p_demo_ok": bool(summary.get("ok"))
+            and proc.returncode == 0})
+    except (subprocess.SubprocessError, OSError, ValueError) as exc:
+        print(f"p2p downtime bench failed: {exc}", file=sys.stderr)
+    finally:
+        _shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def bench_scaler(on_tpu: bool) -> dict:
     """Autoscaler decision quality on the deterministic simulator: how
     fast the ThroughputPolicy closes on the oracle allocation and what
@@ -1148,6 +1213,12 @@ def main() -> None:
     churn = bench_distill_churn(on_tpu)
     ckpt = bench_checkpoint(on_tpu)
     downtime = bench_elastic_downtime(on_tpu)
+    p2p = bench_elastic_downtime_p2p(on_tpu)
+    if downtime.get("elastic_downtime_s") \
+            and p2p.get("elastic_downtime_p2p_s"):
+        p2p["elastic_downtime_reduction_x"] = round(
+            downtime["elastic_downtime_s"]
+            / p2p["elastic_downtime_p2p_s"], 1)
     scaler = bench_scaler(on_tpu)
     cores_to_feed = (resnet["imgs_per_sec"]
                      / max(loader["imgs_per_sec_per_core"], 1e-9))
@@ -1254,6 +1325,10 @@ def main() -> None:
             # elastic stop-resume downtime: SIGKILL a trainer mid-run,
             # respawn, clock kill -> first post-restore step
             **downtime,
+            # p2p live-migration resize downtime (same artifact as the
+            # disk baseline above): survivors adopt in place, joiners
+            # restore from donor memory over the tensor wire
+            **p2p,
             # autoscaler decision plane on the deterministic simulator:
             # ticks-to-converge / vs-oracle gap / downtime paid across
             # concave+flat+knee curves (edl_tpu/scaler)

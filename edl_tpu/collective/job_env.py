@@ -43,6 +43,18 @@ class JobEnv:
     # re-claiming (must exceed peers' watcher poll interval so the blip is
     # observed; the reference sleeps 15s > etcd TTL for the same reason).
     rejoin_delay_secs: float = field(3.0, env="EDL_TPU_REJOIN_DELAY")
+    # Peer-to-peer live state migration (collective/migration.py): on a
+    # membership change, surviving trainers adopt the new world IN PLACE
+    # (no respawn/restore) and every trainer serves its sealed snapshot
+    # to (re)starting peers, with disk as the fallback. 0 restores the
+    # pure stop-resume-from-disk recipe.
+    resize_p2p: bool = field(True, env="EDL_TPU_RESIZE_P2P")
+    # How long a SIGTERM'd trainer keeps serving shards to the re-formed
+    # world before exiting (early-exits once every live pod has acked).
+    donor_linger_secs: float = field(10.0, env="EDL_TPU_DONOR_LINGER")
+    # How long the launcher waits for its trainer to ack an in-place
+    # adoption before falling back to stop-resume with a donor linger.
+    adopt_timeout_secs: float = field(10.0, env="EDL_TPU_ADOPT_TIMEOUT")
 
     def __post_init__(self):
         if not self.pod_id:

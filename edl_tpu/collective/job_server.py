@@ -33,7 +33,8 @@ log = get_logger("edl_tpu.collective.job_server")
 
 class JobState:
     def __init__(self, job_id: str, min_nodes: int, max_nodes: int,
-                 desired: int | None = None, seed: int = 0):
+                 desired: int | None = None, seed: int = 0,
+                 store=None):
         self.job_id = job_id
         self.min_nodes = min_nodes
         self.max_nodes = max_nodes
@@ -48,6 +49,30 @@ class JobState:
         self.resize_log: list[dict] = []
         # RLock: resize()/random_resize() return snapshot() while holding it.
         self._lock = threading.RLock()
+        # Migration plane: with a coordination store attached, every
+        # served resize publishes a monotonic migration epoch + the
+        # donor roster alive at the decision instant (the fencing/audit
+        # record peers and the --resize-p2p demo key on).
+        self.store = store
+        self._migration_epoch = 0
+
+    def attach_store(self, store) -> None:
+        with self._lock:
+            self.store = store
+
+    def _publish_migration_epoch(self, prev: int) -> None:
+        # caller holds self._lock (epoch ordering must match resize_log)
+        if self.store is None:
+            return
+        from edl_tpu.collective import migration as mig
+        self._migration_epoch += 1
+        try:
+            mig.publish_resize_epoch(self.store, self.job_id,
+                                     epoch=self._migration_epoch,
+                                     desired=self.desired, prev=prev)
+        except Exception as exc:  # noqa: BLE001 — best-effort: the
+            # resize itself must be served even if the store hiccups
+            log.warning("migration epoch publish failed: %s", exc)
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -65,6 +90,8 @@ class JobState:
                                     "requested": desired,
                                     "clamped": clamped,
                                     "source": "resize"})
+            if self.desired != prev:
+                self._publish_migration_epoch(prev)
             if clamped:
                 # loud, not silent: the scaler journals the response, so
                 # a clamp must be visible there and in this log
@@ -89,6 +116,8 @@ class JobState:
             self.resize_log.append({"from": prev, "to": self.desired,
                                     "requested": self.desired,
                                     "clamped": False, "source": "fault"})
+            if self.desired != prev:
+                self._publish_migration_epoch(prev)
             log.info("fault injection: desired_nodes -> %d", self.desired)
             return self.snapshot()
 
@@ -294,7 +323,10 @@ def main(argv=None) -> int:
                         help="drive desired_nodes from the autoscaler "
                              "(requires --store)")
     parser.add_argument("--store", default=None,
-                        help="coordination store endpoint for --scaler")
+                        help="coordination store endpoint (required by "
+                             "--scaler; with or without it, /resize "
+                             "publishes migration epochs + donor "
+                             "rosters for p2p state migration)")
     parser.add_argument("--scaler-interval", type=float, default=None,
                         help="decision interval s "
                              "(EDL_TPU_SCALER_INTERVAL)")
@@ -312,8 +344,11 @@ def main(argv=None) -> int:
                        time_interval_to_change=args.time_interval_to_change)
     server.start()
     controller = store = None
-    if args.scaler:
+    if args.store:
         from edl_tpu.coord.redis_store import connect_store
+        store = connect_store(args.store)
+        state.attach_store(store)
+    if args.scaler:
         from edl_tpu.scaler.controller import (ScalerConfig,
                                                ScalerController)
         from edl_tpu.scaler.policy import ThroughputPolicy
@@ -322,7 +357,6 @@ def main(argv=None) -> int:
                      if args.scaler_interval is not None else {})
         config = from_env(ScalerConfig, **overrides)
         config.min_nodes, config.max_nodes = lo, hi
-        store = connect_store(args.store)
         # in-process actuation: no HTTP hop for limits or /resize
         controller = ScalerController(
             store, [args.job_id],

@@ -21,15 +21,18 @@ CLI:
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import time
 
 from edl_tpu.collective import barrier as bar
+from edl_tpu.collective import migration as mig
 from edl_tpu.collective import register as reg
 from edl_tpu.collective.cluster import Pod
 from edl_tpu.collective.job_env import (JobEnv, local_addr, trainer_environ)
-from edl_tpu.collective.process import start_trainer, terminate_trainer
+from edl_tpu.collective.process import (start_trainer, release_trainer,
+                                        terminate_trainer)
 from edl_tpu.collective.watcher import ClusterWatcher
 from edl_tpu.coord.client import StoreClient
 from edl_tpu.coord.store import Store
@@ -69,25 +72,51 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
     crashes = 0
     trainer = None
     watcher = None
+    cluster = None
+    # Donors released into their linger window (state-migration plane):
+    # SIGTERM'd trainers that keep serving their sealed snapshot to the
+    # re-formed world. Reaped each poll; force-killed past the deadline.
+    lingering: list[list] = []  # [TrainerProc, kill_deadline]
+
+    def _reap_lingering() -> None:
+        now = time.monotonic()
+        for item in list(lingering):
+            tp, deadline = item
+            if not tp.alive():
+                lingering.remove(item)
+            elif now > deadline:
+                log.warning("donor pid=%d outlived its linger window; "
+                            "killing group", tp.pid)
+                try:
+                    os.killpg(os.getpgid(tp.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                lingering.remove(item)
+
     try:
         while True:
             if _job_complete(store, job.job_id):
                 log.info("job %s complete", job.job_id)
                 return 0
-            cluster = bar.cluster_barrier(
-                store, job.job_id, pod.pod_id, after_version=last_version,
-                min_nodes=job.min_nodes, stable_secs=job.barrier_stable_secs,
-                timeout=job.barrier_timeout)
-            last_version = cluster.version
-            rank = cluster.rank_of(pod.pod_id)
-            env = trainer_environ(cluster, pod.pod_id, job)
-            trainer = start_trainer(trainer_cmd, env, job.log_dir, rank=rank)
+            if cluster is None:
+                cluster = bar.cluster_barrier(
+                    store, job.job_id, pod.pod_id,
+                    after_version=last_version, min_nodes=job.min_nodes,
+                    stable_secs=job.barrier_stable_secs,
+                    timeout=job.barrier_timeout)
+                last_version = cluster.version
+            if trainer is None:
+                rank = cluster.rank_of(pod.pod_id)
+                env = trainer_environ(cluster, pod.pod_id, job)
+                trainer = start_trainer(trainer_cmd, env, job.log_dir,
+                                        rank=rank)
             watcher = ClusterWatcher(store, cluster).start()
             generation_start = time.monotonic()
 
             restart_reason = None
             while restart_reason is None:
                 time.sleep(poll)
+                _reap_lingering()
                 if _job_complete(store, job.job_id):
                     restart_reason = "complete"
                 elif register.lost.is_set():
@@ -122,8 +151,44 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
                             restart_reason = "crash"
 
             watcher.stop()
+            if restart_reason == "membership" and job.resize_p2p \
+                    and trainer.alive():
+                # Live migration path: re-form the world FIRST (our rank
+                # claim is still held, the trainer keeps training), then
+                # let the running trainer adopt the new generation in
+                # place — no respawn, no re-import, no restore. Its
+                # reform watcher follows the leader-published cluster;
+                # we only wait for the "adopted" ack.
+                cluster = bar.cluster_barrier(
+                    store, job.job_id, pod.pod_id,
+                    after_version=last_version, min_nodes=job.min_nodes,
+                    stable_secs=job.barrier_stable_secs,
+                    timeout=job.barrier_timeout)
+                last_version = cluster.version
+                if cluster.rank_of(pod.pod_id) >= 0 and mig.wait_adopted(
+                        store, job.job_id, pod.pod_id, cluster.version,
+                        timeout=job.adopt_timeout_secs,
+                        is_alive=trainer.alive):
+                    log.info("trainer pid=%d adopted cluster v%d in "
+                             "place", trainer.pid, cluster.version)
+                    crashes = 0
+                    continue  # same trainer; fresh watcher at loop top
+                # Adoption unavailable (trainer without the migration
+                # service, or it stalled): stop-resume — but keep the
+                # old trainer alive as a DONOR so the replacement can
+                # restore its state from memory instead of disk.
+                log.info("in-place adoption unavailable — stop-resume "
+                         "with donor linger (pid=%d)", trainer.pid)
+                release_trainer(trainer)
+                lingering.append([trainer,
+                                  time.monotonic()
+                                  + job.donor_linger_secs + 5.0])
+                trainer = None
+                crashes = 0
+                continue  # cluster already re-formed: respawn directly
             terminate_trainer(trainer)
             trainer = None
+            cluster = None
             if restart_reason == "complete":
                 return 0
             if restart_reason == "crash_loop":
@@ -158,7 +223,16 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
         if watcher is not None:
             watcher.stop()
         if trainer is not None:
-            terminate_trainer(trainer)
+            if job.resize_p2p:
+                # Shrink/shutdown: the trainer converts SIGTERM into a
+                # graceful stop and lingers as a donor (own session, so
+                # it survives this launcher) — exactly how a shrink
+                # victim's shards outlive its own eviction. Its linger
+                # is self-bounded; releasing the claim below lets it
+                # exit early when nobody is left to serve.
+                release_trainer(trainer)
+            else:
+                terminate_trainer(trainer)
         register.release()
     return 0
 

@@ -1,0 +1,750 @@
+"""Peer-to-peer live state migration: the resize path without the disk.
+
+The stop-resume recipe (checkpoint -> kill world -> re-form -> restore
+from disk) pays the full respawn + deserialize price on every membership
+change. This plane converts the checkpoint plane from the hot path into
+the safety net:
+
+- every trainer under the elastic launcher runs a **donor server**: the
+  newest SEALED checkpoint snapshot (the async-checkpoint plane's
+  retained host-side copy — no extra device->host transfer) is served
+  chunk-by-chunk over the zero-copy binary tensor wire
+  (distill/tensor_wire.py gather-send);
+- a (re)starting trainer **restores from peers**: donor manifests are
+  merged into the same self-describing chunk index the on-disk sharded
+  format uses, and the cross-mesh resharding planner
+  (train/sharded_checkpoint.restore_from_index) assembles the target
+  state from parallel region fetches — saved-world and restore-world
+  shapes stay independent;
+- **surviving** trainers never restart at all: a reform watcher follows
+  the leader-published cluster generation, and on a resize that keeps
+  this pod the TrainLoop adopts the new (rank, world) in place — no
+  respawn, no re-import, no re-jit, no restore. Downtime collapses to
+  one step boundary;
+- **disk remains the fallback** whenever peers cannot serve: no live
+  donors (total-world kill), donors staler than the local disk (epoch
+  fencing), or a donor dying mid-transfer all raise `PeerRestoreError`
+  and the caller falls back to `CheckpointManager.restore`.
+
+Store key layout (all under the job scope):
+
+    /{job}/migration/donors/{pod_id}  donor advert JSON, leased
+                                      {pod_id, addr, port, version, step,
+                                       generation, nbytes}
+    /{job}/migration/epoch            resize epoch doc, published by the
+                                      JobServer's /resize (fencing +
+                                      audit): {epoch, ts, from, desired,
+                                      donors}
+    /{job}/migration/ack/{pod_id}     restore/adoption ack {ts, mode:
+                                      peers|disk|adopted, version,
+                                      generation, downtime_s, bytes}
+
+``EDL_TPU_RESIZE_P2P=0`` is the escape hatch back to pure stop-resume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from edl_tpu.coord.store import Store
+from edl_tpu.distill.tensor_wire import (TensorWireError, recv_tensors,
+                                         send_tensors)
+from edl_tpu.utils.exceptions import EdlError
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.collective.migration")
+
+
+class PeerRestoreError(EdlError):
+    """Peer restore is unavailable/failed — caller falls back to disk."""
+
+
+# -- key layout -------------------------------------------------------------
+
+def donors_prefix(job_id: str) -> str:
+    return f"/{job_id}/migration/donors/"
+
+
+def donor_key(job_id: str, pod_id: str) -> str:
+    return f"/{job_id}/migration/donors/{pod_id}"
+
+
+def epoch_key(job_id: str) -> str:
+    return f"/{job_id}/migration/epoch"
+
+
+def ack_prefix(job_id: str) -> str:
+    return f"/{job_id}/migration/ack/"
+
+
+def ack_key(job_id: str, pod_id: str) -> str:
+    return f"/{job_id}/migration/ack/{pod_id}"
+
+
+def p2p_enabled(environ=None) -> bool:
+    env = os.environ if environ is None else environ
+    return env.get("EDL_TPU_RESIZE_P2P", "1") != "0"
+
+
+def live_donors(store: Store, job_id: str) -> list[dict]:
+    """Parsed donor adverts currently alive (leased keys)."""
+    records, _ = store.get_prefix(donors_prefix(job_id))
+    out = []
+    for rec in records:
+        try:
+            out.append(json.loads(rec.value))
+        except json.JSONDecodeError:
+            continue
+    return out
+
+
+# -- donor server -----------------------------------------------------------
+
+class MigrationServer:
+    """Serve the retained sealed snapshot to peers over the tensor wire.
+
+    Protocol (one framed request -> one framed reply, pipelined per
+    connection):
+
+      {op: "manifest"} -> meta {version, status, process_index, leaves}
+      {op: "fetch", files: [...]} -> tensors {fname: chunk}, meta
+                                     {version}
+
+    Requests against a donor that holds no snapshot (or an unknown
+    chunk) get an ``error`` meta instead of a dropped connection, so the
+    restorer can distinguish "donor not ready" from "donor died".
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._lock = threading.Lock()
+        self._snap: dict | None = None
+        self._stop = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="edl-migrate-srv")
+        self._accept.start()
+
+    def publish(self, snapshot: dict) -> None:
+        """Swap in a newer sealed snapshot (serve-ready view from
+        CheckpointManager.sealed_snapshot). In-flight fetches keep their
+        reference to the old one — snapshots are immutable once
+        published, so a swap can never tear a transfer."""
+        with self._lock:
+            self._snap = snapshot
+
+    def snapshot(self) -> dict | None:
+        with self._lock:
+            return self._snap
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            with self._lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="edl-migrate-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._stop.is_set():
+                meta, _ = recv_tensors(conn)
+                self._handle(conn, meta)
+        except (TensorWireError, OSError):
+            pass  # peer done / donor stopping
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, conn: socket.socket, meta: dict) -> None:
+        # overridable seam: tests subclass this to model a donor dying
+        # mid-transfer (manifest served, fetch drops the connection)
+        snap = self.snapshot()
+        op = meta.get("op")
+        if snap is None:
+            send_tensors(conn, {"error": "donor holds no sealed snapshot"})
+            return
+        if op == "manifest":
+            send_tensors(conn, {"op": "manifest",
+                                "version": snap["version"],
+                                "status": snap["status"],
+                                "process_index": snap["process_index"],
+                                "leaves": snap["leaves"]})
+        elif op == "fetch":
+            names = meta.get("files") or []
+            missing = [n for n in names if n not in snap["chunks"]]
+            if missing:
+                send_tensors(conn, {"error": f"unknown chunks {missing}"})
+                return
+            send_tensors(conn, {"op": "fetch", "version": snap["version"]},
+                         {n: snap["chunks"][n] for n in names})
+        else:
+            send_tensors(conn, {"error": f"unknown op {op!r}"})
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+# -- peer restore -----------------------------------------------------------
+
+def _connect(advert: dict, timeout: float) -> socket.socket:
+    sock = socket.create_connection((advert["addr"], int(advert["port"])),
+                                    timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _fetch_manifest(advert: dict, timeout: float) -> dict:
+    with _connect(advert, timeout) as sock:
+        send_tensors(sock, {"op": "manifest"})
+        meta, _ = recv_tensors(sock)
+    if "error" in meta:
+        raise TensorWireError(meta["error"])
+    return meta
+
+
+class _PeerChunks:
+    """Chunk source for `restore_from_index` backed by donor fetches.
+
+    One connection per (donor, reader thread); each chunk is fetched
+    exactly once per restore and cached, mirroring the on-disk
+    `_ChunkFiles` handle cache."""
+
+    def __init__(self, owners: dict[str, dict], timeout: float,
+                 expect_version: int | None = None):
+        self.owners = owners            # chunk fname -> donor advert
+        self.timeout = timeout
+        # version fence: a donor sealing a NEWER snapshot mid-restore
+        # must not mix steps into the assembled state
+        self.expect_version = expect_version
+        self._cache: dict[str, np.ndarray] = {}
+        self._cache_lock = threading.Lock()
+        self._inflight: dict[str, threading.Lock] = {}
+        self._local = threading.local()
+        self._all_socks: list[socket.socket] = []
+        self._socks_lock = threading.Lock()
+        self.bytes_fetched = 0
+
+    def _sock_for(self, advert: dict) -> socket.socket:
+        pool = getattr(self._local, "socks", None)
+        if pool is None:
+            pool = self._local.socks = {}
+        key = (advert["addr"], advert["port"])
+        sock = pool.get(key)
+        if sock is None:
+            sock = pool[key] = _connect(advert, self.timeout)
+            with self._socks_lock:
+                self._all_socks.append(sock)
+        return sock
+
+    def load(self, fname: str) -> np.ndarray:
+        # per-chunk single-flight: two reader threads planning regions
+        # that intersect the same chunk must not both pull it over the
+        # wire (each chunk crosses once, like the mmap handle cache)
+        with self._cache_lock:
+            arr = self._cache.get(fname)
+            if arr is not None:
+                return arr
+            flight = self._inflight.setdefault(fname, threading.Lock())
+        with flight:
+            with self._cache_lock:
+                arr = self._cache.get(fname)
+            if arr is not None:
+                return arr
+            return self._fetch(fname)
+
+    def _fetch(self, fname: str) -> np.ndarray:
+        advert = self.owners.get(fname)
+        if advert is None:
+            raise PeerRestoreError(f"no donor owns chunk {fname}")
+        sock = self._sock_for(advert)
+        send_tensors(sock, {"op": "fetch", "files": [fname]})
+        meta, tensors = recv_tensors(sock)
+        if "error" in meta or fname not in tensors:
+            raise PeerRestoreError(
+                f"donor {advert.get('pod_id')} failed serving {fname}: "
+                f"{meta.get('error', 'chunk missing from reply')}")
+        if self.expect_version is not None \
+                and int(meta.get("version", -1)) != self.expect_version:
+            raise PeerRestoreError(
+                f"donor {advert.get('pod_id')} moved to version "
+                f"{meta.get('version')} mid-restore (wanted "
+                f"{self.expect_version})")
+        arr = tensors[fname]
+        with self._cache_lock:
+            self._cache[fname] = arr
+            self.bytes_fetched += arr.nbytes
+        return arr
+
+    def close(self) -> None:
+        with self._socks_lock:
+            socks, self._all_socks = self._all_socks, []
+        for sock in socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def restore_from_peers(store: Store, job_id: str, target: Any, *,
+                       local_version: int | None = None,
+                       threads: int | None = None,
+                       timeout: float = 5.0) -> tuple[Any, Any, dict]:
+    """Assemble ``target``'s state from live donor snapshots.
+
+    Donor adverts are read from the store, the newest advertised version
+    wins, and manifests are merged into one chunk index — exactly the
+    cross-mesh resharding plan a disk restore builds from index files,
+    so peer- and disk-restored states are bitwise identical. ``local_
+    version`` is the epoch fence: when this pod's own disk already holds
+    a NEWER sealed version than any donor (e.g. every donor died and
+    came back stale), peers are refused and the caller restores from
+    disk instead.
+
+    Returns ``(state, TrainStatus, stats)``; raises `PeerRestoreError`
+    on any condition where disk is the right path.
+    """
+    from edl_tpu.train import sharded_checkpoint as sc
+    from edl_tpu.train.state import TrainStatus
+
+    adverts = live_donors(store, job_id)
+    if not adverts:
+        raise PeerRestoreError("no live donors advertised")
+    # The advert is DISCOVERY only — the manifest carries the live
+    # sealed version (adverts refresh off-thread and may lag a seal).
+    manifests: dict[str, dict] = {}
+    owners: dict[str, dict] = {}
+    by_version: dict[int, list[tuple[dict, dict]]] = {}
+    for advert in adverts:
+        try:
+            man = _fetch_manifest(advert, timeout)
+        except (OSError, TensorWireError) as exc:
+            log.warning("donor %s unreachable for manifest: %s",
+                        advert.get("pod_id"), exc)
+            continue
+        by_version.setdefault(int(man["version"]), []).append((advert, man))
+    if not by_version:
+        raise PeerRestoreError("all donors unreachable")
+    # Donors may straddle a seal; the newest consistent group wins
+    # (mixing versions would interleave states from different steps).
+    chosen = max(by_version)
+    if local_version is not None and local_version > chosen:
+        # Epoch fence: a stale donor never beats this pod's own newer
+        # sealed checkpoint (e.g. the whole world died and one donor
+        # came back serving an old snapshot).
+        raise PeerRestoreError(
+            f"donors stale: best peer version {chosen} < local disk "
+            f"version {local_version}")
+    for advert, man in by_version[chosen]:
+        manifests[advert.get("pod_id", advert["addr"])] = man
+        for leaf in man["leaves"]:
+            for chunk in leaf["chunks"]:
+                owners.setdefault(chunk["file"], advert)
+    merged = sc.merge_leaf_tables([m["leaves"] for m in manifests.values()])
+    source = _PeerChunks(owners, timeout, expect_version=chosen)
+    t0 = time.perf_counter()
+    try:
+        state = sc.restore_from_index(merged, source.load, target, threads)
+    except PeerRestoreError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — donor death mid-transfer,
+        # coverage holes, wire errors: all mean "go restore from disk"
+        raise PeerRestoreError(f"peer fetch failed: {exc}") from exc
+    finally:
+        source.close()
+    status = TrainStatus.from_dict(
+        next(iter(manifests.values()))["status"])
+    stats = {"version": chosen,
+             "bytes_from_peers": source.bytes_fetched,
+             "donors": sorted(manifests),
+             "restore_s": round(time.perf_counter() - t0, 4)}
+    log.info("restored v%d from %d peer(s) in %.3fs (%.1f MB over the "
+             "wire)", chosen, len(manifests), stats["restore_s"],
+             source.bytes_fetched / 2**20)
+    return state, status, stats
+
+
+# -- trainer-side service ---------------------------------------------------
+
+class Reform:
+    """A pending in-place adoption: the new cluster still contains us."""
+
+    def __init__(self, cluster, rank: int, world_size: int):
+        self.cluster = cluster
+        self.rank = rank
+        self.world_size = world_size
+        self.generation = cluster.version
+
+
+class MigrationService:
+    """Everything a trainer process contributes to the migration plane.
+
+    - serves its retained sealed snapshot (attach() wires a
+      CheckpointManager's retention hook to the donor server + a leased
+      store advert, refreshed off-thread);
+    - watches the leader-published cluster generation so the TrainLoop
+      can adopt a resize in place (`poll_reform`);
+    - converts SIGTERM into a *graceful* stop (`stop_requested`) and, on
+      shutdown, lingers as a donor until the re-formed world has acked
+      its restores (or a bounded deadline) — how a shrink victim's
+      shards survive its own eviction.
+    """
+
+    def __init__(self, store: Store, job_id: str, pod_id: str, *,
+                 generation: int = 0, ttl: float = 15.0,
+                 linger_s: float = 10.0, addr: str | None = None,
+                 owns_store: bool = False):
+        from edl_tpu.collective.job_env import local_addr
+        self.store = store
+        self.job_id = job_id
+        self.pod_id = pod_id
+        self.ttl = ttl
+        self.linger_s = linger_s
+        self.addr = addr or local_addr()
+        self.generation = generation
+        self._owns_store = owns_store
+        self.server = MigrationServer()
+        self.stop_requested = threading.Event()
+        self._stop_ts: float | None = None
+        self._lease: int | None = None
+        self._keeper = None
+        self._advert_dirty = threading.Event()
+        self._advert_doc: dict | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._advert_thread: threading.Thread | None = None
+        # reform watch
+        self._reform: Reform | None = None
+        self._watch_thread: threading.Thread | None = None
+        self._ckpt = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_env(cls, ckpt=None) -> "MigrationService | None":
+        """Build from the launcher's trainer env; None when p2p is
+        disabled, the trainer runs standalone, or the store is down."""
+        if not p2p_enabled():
+            return None
+        if "EDL_TPU_RANK" not in os.environ:
+            return None  # not under the elastic launcher
+        endpoints = os.environ.get("EDL_TPU_STORE_ENDPOINTS", "")
+        job_id = os.environ.get("EDL_TPU_JOB_ID", "")
+        pod_id = os.environ.get("EDL_TPU_POD_ID", "")
+        if not (endpoints and job_id and pod_id):
+            return None
+        from edl_tpu.coord.redis_store import connect_store
+        try:
+            store = connect_store(endpoints.split(",")[0])
+        except Exception as exc:  # noqa: BLE001 — plane is optional
+            log.warning("migration service disabled (store unreachable: "
+                        "%s)", exc)
+            return None
+        linger = os.environ.get("EDL_TPU_DONOR_LINGER", "").strip()
+        svc = cls(store, job_id, pod_id,
+                  generation=int(os.environ.get(
+                      "EDL_TPU_CLUSTER_VERSION", "0") or 0),
+                  linger_s=float(linger) if linger else 10.0,
+                  owns_store=True)
+        if ckpt is not None:
+            svc.attach(ckpt)
+        svc.start_reform_watch()
+        svc.install_sigterm()
+        return svc
+
+    def attach(self, ckpt) -> None:
+        """Wire a CheckpointManager's sealed-snapshot retention into the
+        donor server: every sealed save republishes the serve-ready view
+        and refreshes the leased advert (off the saving thread)."""
+        self._ckpt = ckpt
+        ckpt.retain_sealed = True
+        ckpt.on_sealed = self._on_sealed
+        existing = ckpt.sealed_snapshot()
+        if existing is not None:
+            self._on_sealed()
+
+    # -- donor advertising -------------------------------------------------
+
+    def _on_sealed(self) -> None:
+        snap = self._ckpt.sealed_snapshot() if self._ckpt else None
+        if snap is None:
+            return
+        self.server.publish(snap)
+        doc = {"pod_id": self.pod_id, "addr": self.addr,
+               "port": self.server.port,
+               "version": snap["version"],
+               "step": (snap["status"] or {}).get("step"),
+               "generation": self.generation,
+               "nbytes": int(sum(a.nbytes
+                                 for a in snap["chunks"].values())),
+               "ts": time.time()}
+        with self._lock:
+            self._advert_doc = doc
+            if self._advert_thread is None:
+                self._advert_thread = threading.Thread(
+                    target=self._advert_loop, daemon=True,
+                    name="edl-migrate-advert")
+                self._advert_thread.start()
+        self._advert_dirty.set()
+
+    def _advert_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._advert_dirty.wait(timeout=0.2):
+                continue
+            self._advert_dirty.clear()
+            with self._lock:
+                doc = self._advert_doc
+            if doc is None:
+                continue
+            try:
+                self.store.put(donor_key(self.job_id, self.pod_id),
+                               json.dumps(doc, sort_keys=True),
+                               lease=self._ensure_lease())
+            except Exception as exc:  # noqa: BLE001 — best-effort: a
+                # failed advert only hides this donor from peers
+                log.warning("donor advert publish failed: %s", exc)
+                self._lease = None
+
+    def _ensure_lease(self) -> int:
+        if self._lease is not None and self._keeper is not None \
+                and not self._keeper.lost.is_set():
+            return self._lease
+        from edl_tpu.coord.client import LeaseKeeper
+        if self._keeper is not None:
+            self._keeper.stop(revoke=False)
+        self._lease = self.store.lease_grant(self.ttl)
+        self._keeper = LeaseKeeper(self.store, self._lease,
+                                   interval=self.ttl / 6.0).start()
+        return self._lease
+
+    # -- reform watch (in-place adoption) ----------------------------------
+
+    def start_reform_watch(self, interval: float = 0.3) -> None:
+        if self._watch_thread is not None:
+            return
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, args=(interval,), daemon=True,
+            name="edl-migrate-reform")
+        self._watch_thread.start()
+
+    def _watch_loop(self, interval: float) -> None:
+        from edl_tpu.collective import register as reg
+        from edl_tpu.collective.cluster import Cluster
+        parsed_revision = -1
+        while not self._stop.wait(interval):
+            try:
+                rec = self.store.get(reg.cluster_key(self.job_id))
+            except Exception as exc:  # noqa: BLE001 — transient store
+                log.debug("reform watch poll failed: %s", exc)
+                continue
+            if rec is None or rec.revision == parsed_revision:
+                continue
+            parsed_revision = rec.revision
+            try:
+                cluster = Cluster.from_json(rec.value)
+            except (ValueError, TypeError):
+                continue
+            if cluster.version <= self.generation:
+                continue
+            rank = cluster.rank_of(self.pod_id)
+            if rank < 0:
+                # evicted from the new world: nothing to adopt — the
+                # launcher's SIGTERM drives the graceful donor path
+                continue
+            with self._lock:
+                self._reform = Reform(cluster, rank, cluster.world_size)
+
+    def poll_reform(self) -> Reform | None:
+        """The newest pending adoption (cleared by `adopted`)."""
+        with self._lock:
+            return self._reform
+
+    def adopted(self, reform: Reform) -> None:
+        """Mark `reform` consumed and re-stamp this donor's generation
+        (newer pending reforms survive the clear)."""
+        with self._lock:
+            self.generation = reform.generation
+            if self._reform is not None \
+                    and self._reform.generation <= reform.generation:
+                self._reform = None
+        # refresh the advert's generation so peers can correlate
+        self._advert_dirty.set()
+
+    # -- acks --------------------------------------------------------------
+
+    def ack(self, mode: str, *, version: int | None = None,
+            downtime_s: float | None = None, bytes_from_peers: int = 0,
+            restore_s: float | None = None) -> None:
+        """Record that this pod is trained-and-running in the current
+        generation (written AFTER the first post-restore/post-adoption
+        step): what lingering donors key their early exit on, and what
+        the demo/bench read the measured downtime from."""
+        doc = {"pod_id": self.pod_id, "mode": mode, "ts": time.time(),
+               "generation": self.generation, "version": version,
+               "downtime_s": downtime_s,
+               "bytes_from_peers": int(bytes_from_peers),
+               "restore_s": restore_s}
+        try:
+            self.store.put(ack_key(self.job_id, self.pod_id),
+                           json.dumps(doc, sort_keys=True))
+        except Exception as exc:  # noqa: BLE001 — observability only
+            log.warning("migration ack failed: %s", exc)
+
+    # -- restore (consumer side) -------------------------------------------
+
+    def restore_from_peers(self, target: Any, *,
+                           local_version: int | None = None,
+                           threads: int | None = None):
+        return restore_from_peers(self.store, self.job_id, target,
+                                  local_version=local_version,
+                                  threads=threads)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def install_sigterm(self) -> None:
+        """Convert SIGTERM into a graceful stop: the TrainLoop finishes
+        its step, drains the last snapshot, then lingers as a donor.
+        No-op off the main thread (signal API restriction)."""
+        import signal as _signal
+
+        def _handler(signum, frame):
+            self._stop_ts = time.time()
+            self.stop_requested.set()
+        try:
+            _signal.signal(_signal.SIGTERM, _handler)
+        except ValueError:  # not the main thread
+            log.debug("SIGTERM handler not installed (non-main thread)")
+
+    def _linger(self) -> None:
+        """Serve until the re-formed world acked or the deadline passes.
+
+        Early exits: every live rank claim has a fresh ack (the new
+        world is fully up), or there are no live claims at all (nobody
+        left to serve — e.g. the whole job is shutting down)."""
+        from edl_tpu.collective import register as reg
+        since = self._stop_ts or time.time()
+        deadline = time.monotonic() + self.linger_s
+        log.info("donor linger: serving peers up to %.1fs", self.linger_s)
+        while time.monotonic() < deadline:
+            try:
+                claims, _ = self.store.get_prefix(
+                    reg.ranks_prefix(self.job_id))
+                acks, _ = self.store.get_prefix(ack_prefix(self.job_id))
+            except Exception:  # noqa: BLE001 — store gone: stop serving
+                return
+            fresh = 0
+            for rec in acks:
+                try:
+                    if float(json.loads(rec.value).get("ts", 0)) >= since:
+                        fresh += 1
+                except (ValueError, TypeError):
+                    continue
+            if not claims:
+                return
+            if fresh >= len(claims):
+                log.info("donor linger: %d/%d fresh acks — done", fresh,
+                         len(claims))
+                return
+            time.sleep(0.3)
+
+    def shutdown(self, linger: bool | None = None) -> None:
+        """Stop serving. ``linger`` defaults to 'only when a graceful
+        stop was requested and we hold something worth serving'."""
+        if linger is None:
+            linger = (self.stop_requested.is_set()
+                      and self.server.snapshot() is not None)
+        if linger:
+            try:
+                self._linger()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                log.exception("donor linger failed")
+        self._stop.set()
+        self.server.stop()
+        for t in (self._advert_thread, self._watch_thread):
+            if t is not None:
+                t.join(timeout=2.0)
+        self._advert_thread = self._watch_thread = None
+        if self._ckpt is not None:
+            self._ckpt.on_sealed = None
+        if self._keeper is not None:
+            self._keeper.stop(revoke=True)
+            self._keeper = None
+            self._lease = None
+        if self._owns_store:
+            self._owns_store = False
+            try:
+                self.store.close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+
+
+# -- launcher-side helpers --------------------------------------------------
+
+def wait_adopted(store: Store, job_id: str, pod_id: str, generation: int,
+                 timeout: float, poll: float = 0.2,
+                 is_alive: Callable[[], bool] | None = None) -> bool:
+    """Launcher side of in-place adoption: block until this pod's
+    trainer acked generation >= `generation` (True), the trainer died,
+    or the timeout passed (False -> fall back to stop-resume)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if is_alive is not None and not is_alive():
+            return False
+        try:
+            rec = store.get(ack_key(job_id, pod_id))
+        except Exception:  # noqa: BLE001 — transient store error
+            rec = None
+        if rec is not None:
+            try:
+                doc = json.loads(rec.value)
+                if doc.get("mode") == "adopted" \
+                        and int(doc.get("generation") or 0) >= generation:
+                    return True
+            except (ValueError, TypeError):
+                pass
+        time.sleep(poll)
+    return False
+
+
+def publish_resize_epoch(store: Store, job_id: str, *, epoch: int,
+                         desired: int, prev: int | None = None) -> dict:
+    """JobServer /resize hook: stamp a monotonic migration epoch with
+    the donor roster alive at the decision instant — the fencing +
+    audit record the demo and docs key on."""
+    roster = [{k: d.get(k) for k in ("pod_id", "addr", "port", "version",
+                                     "generation")}
+              for d in live_donors(store, job_id)]
+    doc = {"epoch": int(epoch), "ts": time.time(), "from": prev,
+           "desired": int(desired), "donors": roster}
+    store.put(epoch_key(job_id), json.dumps(doc, sort_keys=True))
+    return doc

@@ -53,6 +53,24 @@ def start_trainer(cmd: list[str], env: dict, log_dir: str,
     return TrainerProc(proc=proc, log_path=log_path, cmd=list(cmd))
 
 
+def release_trainer(tp: TrainerProc) -> None:
+    """SIGTERM the trainer group and return immediately — the donor
+    path of the state-migration plane: a migration-enabled trainer
+    converts SIGTERM into a graceful stop plus a bounded donor linger
+    (it keeps serving its sealed snapshot to the re-formed world), so
+    the caller must neither block on it nor escalate to SIGKILL the way
+    `terminate_trainer` does. The caller owns the eventual force-kill
+    deadline (launch.py's lingering reap)."""
+    if not tp.alive():
+        return
+    try:
+        os.killpg(os.getpgid(tp.pid), signal.SIGTERM)
+        log.info("released trainer pid=%d (graceful stop + donor linger)",
+                 tp.pid)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
 def terminate_trainer(tp: TrainerProc, grace: float = 10.0) -> None:
     """SIGTERM the process group, escalate to SIGKILL after `grace`."""
     if not tp.alive():

@@ -8,6 +8,13 @@ Key layout:
     /{job}/ranks/{i}   -> Pod JSON, leased (ephemeral)   — the claim
     /{job}/cluster     -> Cluster JSON, permanent        — leader-published
     /{job}/complete    -> "1", permanent                 — job done marker
+
+The state-migration plane (collective/migration.py) hangs its donor
+adverts, resize epochs, and restore/adoption acks off the same job
+scope under /{job}/migration/ — a released claim is what lets a
+lingering donor's `_linger` see that nobody is left to serve, so
+`release()` must keep revoking the lease eagerly (never TTL-drain) on
+the graceful paths.
 """
 
 from __future__ import annotations

@@ -78,8 +78,12 @@ def send_tensors(sock: socket.socket, meta: dict[str, Any],
     for name, arr in tensors.items():
         # numpy-native dtypes only: senders downcast/upcast extension dtypes
         # (e.g. device bf16) to a wire dtype first — teacher logits travel
-        # as float32.
-        arr = np.ascontiguousarray(arr)
+        # as float32. np.ascontiguousarray promotes 0-d arrays to (1,),
+        # so guard it: scalar tensors (state-migration chunks of opt-state
+        # counters) must round-trip with their shape intact.
+        arr = np.asarray(arr)
+        if arr.ndim and not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
         if arr.dtype.str.startswith(("<V", "|V", ">V")):
             raise TensorWireError(
                 f"non-wire dtype {arr.dtype} for tensor {name!r}")

@@ -193,6 +193,177 @@ def run_scaler_demo(args) -> int:
     return 0 if complete and not escaped and not silent else 1
 
 
+def run_p2p_demo(args) -> int:
+    """Peer-to-peer state migration end-to-end on one host: in-process
+    store + JobServer (store-attached, so /resize publishes migration
+    epochs) + JobClient-spawned launcher pods running THIS trainer, with
+    a scripted shrink and grow driven through /resize. Self-audits that
+    the p2p plane actually carried the resizes:
+
+      - at least one pod ADOPTED a resize in place (no respawn),
+      - at least one pod restored FROM PEERS with bytes over the wire,
+      - /resize published a migration epoch per applied resize,
+
+    and exits 1 when any of it silently degraded to the disk recipe.
+    Prints a machine-readable ``p2p_summary=`` line (bench.py reads
+    ``elastic_downtime_p2p_s`` — the worst surviving-pod training gap —
+    and ``resize_bytes_from_peers`` from it)."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    from edl_tpu.collective import migration as mig
+    from edl_tpu.collective import register as reg
+    from edl_tpu.collective.barrier import read_cluster
+    from edl_tpu.collective.job_server import (JobClient, JobServer,
+                                               JobState, request_resize)
+    from edl_tpu.coord.server import StoreServer
+
+    # the pods are CPU trainers (the orchestration is the demo)
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_NUM_CPU_DEVICES"] = "1"
+    # fast membership plumbing so the measured gaps are the migration
+    # plane's, not the default 10s leases (children inherit these)
+    os.environ.setdefault("EDL_TPU_BARRIER_STABLE", "0.5")
+    os.environ.setdefault("EDL_TPU_LEASE_TTL", "3.0")
+    os.environ["EDL_TPU_RESIZE_P2P"] = "1"
+
+    job_id = "p2p_demo"
+    lo, hi = (int(x) for x in args.nodes_range.split(":"))
+    if hi < 2:
+        hi = 2
+    tmp = tempfile.mkdtemp(prefix="edl-p2p-demo-")
+    srv = StoreServer(port=0, host="127.0.0.1", sweep_interval=0.2).start()
+    store_ep = f"127.0.0.1:{srv.port}"
+    state = JobState(job_id, lo, hi, desired=hi, store=srv.store)
+    server = JobServer(state, port=0).start()
+    # long enough that training spans both scripted resizes
+    epochs = max(args.epochs, 30)
+    steps = max(args.steps_per_epoch, 20)
+    step_time = args.step_time or 0.06
+    trainer_cmd = [
+        sys.executable, "-m", "edl_tpu.collective.launch",
+        "--store", store_ep, "--job-id", job_id,
+        "--nodes-range", f"{lo}:{hi}",
+        "--checkpoint-path", os.path.join(tmp, "ckpt"),
+        "--log-dir", os.path.join(tmp, "log"), "--",
+        sys.executable, "-m", "edl_tpu.examples.elastic_demo",
+        "--epochs", str(epochs), "--steps-per-epoch", str(steps),
+        "--batch", str(args.batch), "--step-time", str(step_time),
+        "--ckpt-steps", str(args.ckpt_steps or 10)]
+    client = JobClient(f"127.0.0.1:{server.port}", trainer_cmd, poll=0.5)
+    client_thread = threading.Thread(target=client.run, daemon=True,
+                                     name="p2p-demo-jobclient")
+
+    acks: dict[tuple, dict] = {}   # (pod_id, ts) -> ack doc
+
+    def sample_acks() -> None:
+        records, _ = srv.store.get_prefix(mig.ack_prefix(job_id))
+        for rec in records:
+            try:
+                doc = json.loads(rec.value)
+                acks[(doc["pod_id"], doc["ts"])] = doc
+            except (ValueError, KeyError):
+                continue
+
+    def wait_for(pred, timeout, what) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            sample_acks()
+            if pred():
+                return True
+            time.sleep(0.25)
+        log.error("p2p demo: timeout waiting for %s", what)
+        return False
+
+    def world() -> int:
+        c = read_cluster(srv.store, job_id)
+        return c.world_size if c is not None else 0
+
+    phases_ok = True
+    complete = False
+    t_shrink = t_grow = None
+    try:
+        client_thread.start()
+        # Phase 1: full world up, at least one donor advertising a
+        # sealed snapshot (training + checkpointing live).
+        phases_ok &= wait_for(
+            lambda: world() == hi and mig.live_donors(srv.store, job_id),
+            args.p2p_timeout, "world up with live donors")
+        if phases_ok:
+            # Phase 2: shrink. Survivors must ADOPT in place.
+            t_shrink = time.time()
+            request_resize(f"127.0.0.1:{server.port}", lo)
+            phases_ok &= wait_for(
+                lambda: world() == lo and any(
+                    d["mode"] == "adopted" and d["ts"] > t_shrink
+                    for d in acks.values()),
+                args.p2p_timeout, "shrink adopted in place")
+        if phases_ok:
+            # Phase 3: grow. The new pod must restore FROM PEERS.
+            time.sleep(2.0)  # let survivors seal fresh versions
+            t_grow = time.time()
+            request_resize(f"127.0.0.1:{server.port}", hi)
+            phases_ok &= wait_for(
+                lambda: world() == hi and any(
+                    d["mode"] == "peers" and d["ts"] > t_grow
+                    for d in acks.values()),
+                args.p2p_timeout, "grow restored from peers")
+        # Let the job finish (proves the migrated world still trains).
+        if phases_ok:
+            complete = wait_for(
+                lambda: srv.store.get(reg.complete_key(job_id))
+                is not None,
+                args.p2p_timeout + epochs * steps * step_time,
+                "job completion")
+        sample_acks()
+    finally:
+        client.stop()
+        client_thread.join(timeout=15)
+        for p in client.procs:  # belt and braces: no orphan launchers
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+        srv.stop()
+
+    adoptions = [d for d in acks.values() if d["mode"] == "adopted"]
+    peer_restores = [d for d in acks.values() if d["mode"] == "peers"]
+    disk_restores = [d for d in acks.values() if d["mode"] == "disk"]
+    bytes_from_peers = sum(d.get("bytes_from_peers") or 0
+                           for d in peer_restores)
+    gaps = [d["downtime_s"] for d in adoptions
+            if d.get("downtime_s") is not None]
+    ok = (phases_ok and complete and len(adoptions) >= 1
+          and len(peer_restores) >= 1 and bytes_from_peers > 0
+          and state._migration_epoch >= 2)
+    summary = {
+        "ok": ok, "complete": complete,
+        "adoptions": len(adoptions),
+        "peer_restores": len(peer_restores),
+        "disk_restores": len(disk_restores),
+        "resize_bytes_from_peers": bytes_from_peers,
+        # worst surviving-pod training gap across the scripted resizes:
+        # the p2p analogue of the kill->first-step stop-resume downtime
+        "elastic_downtime_p2p_s": round(max(gaps), 4) if gaps else None,
+        "adoption_gaps_s": [round(g, 4) for g in sorted(gaps)],
+        "peer_restore_s": [d.get("restore_s") for d in peer_restores],
+        "migration_epochs_published": state._migration_epoch,
+        "served_resizes": state.resize_log}
+    log.info("p2p demo done: %s", summary)
+    if not ok:
+        log.error("p2p audit failed: the resize path fell back to the "
+                  "disk recipe (adoptions=%d peer_restores=%d bytes=%d "
+                  "epochs=%d complete=%s)", len(adoptions),
+                  len(peer_restores), bytes_from_peers,
+                  state._migration_epoch, complete)
+    print("p2p_summary=" + json.dumps(summary), flush=True)
+    shutil.rmtree(tmp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--epochs", type=int, default=5)
@@ -219,7 +390,18 @@ def main(argv=None) -> int:
     parser.add_argument("--scaler-timeout", type=float, default=300.0)
     parser.add_argument("--journal", default=None,
                         help="--scaler: keep the decision journal here")
+    # peer-to-peer migration demo (see run_p2p_demo)
+    parser.add_argument("--resize-p2p", action="store_true",
+                        help="run the live-migration loop: store + "
+                             "JobServer + pods, scripted shrink/grow, "
+                             "self-audited p2p adoption + peer restore")
+    parser.add_argument("--p2p-timeout", type=float, default=120.0,
+                        help="--resize-p2p: per-phase timeout seconds")
     args = parser.parse_args(argv)
+    if args.scaler and args.resize_p2p:
+        parser.error("--scaler and --resize-p2p are separate demos")
+    if args.resize_p2p:
+        return run_p2p_demo(args)
     if args.scaler:
         return run_scaler_demo(args)
 
@@ -250,10 +432,19 @@ def main(argv=None) -> int:
         ckpt_kw["ckpt_every_steps"] = args.ckpt_steps
     if args.ckpt_sync:
         ckpt_kw["ckpt_async"] = False
+
+    def on_reform(rank, world, cluster):
+        # Live migration: a resize that keeps this pod re-enters the
+        # epoch in place — re-derive the data shard for the new world
+        # (make_data reads env at each data_fn call).
+        env.rank, env.world_size = rank, world
+        env.cluster_version = cluster.version
+
     loop = TrainLoop(step, state, config=from_env(
         LoopConfig, num_epochs=args.epochs,
         ckpt_dir=env.checkpoint_path or None,
-        log_every_steps=args.steps_per_epoch, **ckpt_kw))
+        log_every_steps=args.steps_per_epoch, **ckpt_kw),
+        on_reform=on_reform)
     status = loop.run(lambda epoch: make_data(
         epoch, env.rank, env.world_size, args.steps_per_epoch, args.batch))
 
@@ -261,7 +452,10 @@ def main(argv=None) -> int:
     b = float(np.asarray(loop.state.params["Dense_0"]["bias"])[0])
     log.info("done: epoch=%d step=%d w=%.3f b=%.3f", status.epoch,
              status.step, w, b)
-    # machine-readable for the elastic-downtime bench (bench.py)
+    # machine-readable for the elastic-downtime bench (bench.py). A
+    # graceful SIGTERM stop never reaches here: loop.run raises
+    # SystemExit(143) after its donor linger (the launcher must not
+    # read a stopped trainer as "training complete").
     print("ckpt_stats=" + json.dumps(loop.ckpt_stats()), flush=True)
     return 0
 

@@ -45,9 +45,17 @@ class ScalerConfig:
     interval: float = field(5.0, env="EDL_TPU_SCALER_INTERVAL")
     cooldown_s: float = field(30.0, env="EDL_TPU_SCALER_COOLDOWN")
     gain_threshold: float = field(0.05, env="EDL_TPU_SCALER_GAIN")
-    # the measured stop-resume price (bench.py elastic_downtime_s) the
-    # policy amortizes every resize against
+    # the resize price the policy amortizes every grow against — the
+    # FALLBACK only: the controller measures the real downtime of every
+    # resize it actuates (actuation -> first fresh utilization at the
+    # new world) and feeds the per-job EWMA into the policy instead, so
+    # a faster resize path (p2p live migration) loosens the grow gate
+    # without anyone re-tuning a constant
     downtime_s: float = field(1.5, env="EDL_TPU_ELASTIC_DOWNTIME_S")
+    # optional bench artifact (BENCH_r*.json) seeding the fallback:
+    # extras.elastic_downtime_p2p_s preferred over elastic_downtime_s
+    downtime_artifact: str | None = field(None,
+                                          env="EDL_TPU_DOWNTIME_ARTIFACT")
     # utilization docs older than this are ignored (published_unix)
     staleness_s: float = field(15.0, env="EDL_TPU_SCALER_STALENESS")
     min_nodes: int = field(1, env="EDL_TPU_SCALER_MIN_NODES")
@@ -62,6 +70,26 @@ def journal_prefix(scope: str) -> str:
 
 def leader_key(scope: str) -> str:
     return f"/{scope}/scaler/leader"
+
+
+def artifact_downtime(path: str) -> float | None:
+    """Read a measured elastic downtime out of a bench artifact
+    (``extras.elastic_downtime_p2p_s`` preferred — the live-migration
+    number — else ``elastic_downtime_s``). None when unreadable."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    extras = doc.get("extras", doc) or {}
+    for key in ("elastic_downtime_p2p_s", "elastic_downtime_s"):
+        val = extras.get(key)
+        if val is not None:
+            try:
+                return float(val)
+            except (TypeError, ValueError):
+                continue
+    return None
 
 
 class DecisionJournal:
@@ -176,6 +204,22 @@ class ScalerController:
                                        keep=self.config.journal_keep)
         self._collectors = {j: Collector(store, job_id=j)
                             for j in self.jobs}
+        # Measured-downtime feedback: resizes this controller actuated,
+        # awaiting their first fresh utilization at the new world — the
+        # close of a probe updates the per-job EWMA that replaces the
+        # configured downtime constant in every subsequent JobView
+        # (quantized to the tick interval, so it over- rather than
+        # under-charges the amortization gate).
+        self._downtime: dict[str, float] = {}
+        self._resize_pending: dict[str, tuple[float, int]] = {}
+        self._observed_downtime: dict[str, float] = {}  # this tick's
+        self._default_downtime = self.config.downtime_s
+        if self.config.downtime_artifact:
+            seeded = artifact_downtime(self.config.downtime_artifact)
+            if seeded is not None:
+                self._default_downtime = seeded
+                log.info("downtime fallback seeded from %s: %.2fs",
+                         self.config.downtime_artifact, seeded)
         self.election = None
         if elect:
             from edl_tpu.coord.lock import LeaderElection
@@ -225,11 +269,34 @@ class ScalerController:
                 continue  # pre-resize record: wrong allocation's rate
             throughput += float(util.get("examples_per_sec", 0.0))
             fresh_pods += 1
+        fresh = bool(fresh_pods) and world > 0
+        self._note_downtime(job_id, world, fresh, now)
         return JobView(job_id, world, throughput, lo, hi,
-                       self.config.downtime_s,
+                       self._downtime.get(job_id, self._default_downtime),
                        generation=job.get("generation"),
                        desired=desired,
-                       fresh=bool(fresh_pods) and world > 0)
+                       fresh=fresh)
+
+    def _note_downtime(self, job_id: str, world: int, fresh: bool,
+                       now: float) -> None:
+        """Close an open downtime probe: the first FRESH utilization at
+        the resize's target world stamps `actuation -> now` as that
+        resize's measured downtime and folds it into the per-job EWMA
+        the policy amortizes against."""
+        pending = self._resize_pending.get(job_id)
+        if pending is None or not fresh:
+            return
+        ts, target = pending
+        if world != target:
+            return
+        measured = max(0.0, now - ts)
+        prev = self._downtime.get(job_id)
+        self._downtime[job_id] = (measured if prev is None
+                                  else 0.5 * prev + 0.5 * measured)
+        self._observed_downtime[job_id] = measured
+        del self._resize_pending[job_id]
+        log.info("measured elastic downtime for %s: %.2fs (ema %.2fs)",
+                 job_id, measured, self._downtime[job_id])
 
     # -- actuation ----------------------------------------------------------
 
@@ -250,6 +317,15 @@ class ScalerController:
         entries = self.journal.tail()
         if entries:
             self.policy.restore(entries)
+            # replay measured downtimes too: a takeover leader must not
+            # fall back to the configured constant when the journal
+            # already recorded how fast this fleet really resizes
+            for e in entries:
+                job, m = e.get("job_id"), e.get("observed_downtime_s")
+                if job and m is not None:
+                    prev = self._downtime.get(job)
+                    self._downtime[job] = (float(m) if prev is None
+                                           else 0.5 * prev + 0.5 * float(m))
             log.info("restored %d journal entries (scope %s)",
                      len(entries), self.scope)
         self._restored = True
@@ -282,6 +358,10 @@ class ScalerController:
                     if resp.get("clamped"):
                         reason += "; clamped by job server"
                     self.policy.notify_resized(view.job_id, applied, now)
+                    # arm the downtime probe (closed by _note_downtime
+                    # on the first fresh record at the new world; a
+                    # follow-up resize re-arms it at the newer target)
+                    self._resize_pending[view.job_id] = (now, applied)
                     log.info("resize %s: %d -> %d (%s)", view.job_id,
                              prop.current, applied, prop.reason)
                 except Exception as exc:  # noqa: BLE001 — journal it;
@@ -294,6 +374,12 @@ class ScalerController:
             "generation": view.generation, "fresh": view.fresh,
             "current": prop.current, "desired": prop.desired,
             "applied": applied, "action": action, "reason": reason,
+            # the downtime charge this decision amortized against, and
+            # (when a probe closed this tick) the freshly measured value
+            "downtime_s": round(view.downtime_s, 3),
+            "observed_downtime_s": (
+                round(self._observed_downtime.pop(view.job_id), 3)
+                if view.job_id in self._observed_downtime else None),
             "predicted_gain": (round(prop.predicted_gain, 3)
                                if prop.predicted_gain is not None
                                else None)})

@@ -42,7 +42,13 @@ class JobView:
     throughput: float          # aggregate fresh examples/sec across pods
     min_nodes: int = 1
     max_nodes: int = 8
-    downtime_s: float = 1.5    # measured stop-resume price of one resize
+    # The price of one resize that every grow must amortize. The live
+    # controller feeds the MEASURED per-job EWMA here (actuation ->
+    # first fresh utilization at the new world, journal-replayed across
+    # leader takeovers); the configured constant / bench artifact is
+    # only the fallback before the first observation — so a faster
+    # resize path (p2p live migration) loosens the grow gate on its own.
+    downtime_s: float = 1.5
     generation: int | None = None
     desired: int | None = None  # job-server desired (None = world_size)
     fresh: bool = True         # False: stale/reforming — do not learn

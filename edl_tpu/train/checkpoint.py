@@ -117,6 +117,20 @@ class CheckpointManager:
         self._staging_free: list[list] = []
         self._staging_key: tuple | None = None
         self._async_fallback_logged = False
+        # -- sealed-snapshot retention (state-migration donor plane) -------
+        # When retain_sealed is set (collective/migration.py), the newest
+        # successfully sealed save's HOST-side payload is kept in memory
+        # so surviving pods can serve it to peers during a resize without
+        # re-reading disk. Retained payloads are never recycled back into
+        # the staging pool — a fetch in flight may still be reading the
+        # previous snapshot when a newer one seals, and np.copyto-ing
+        # over it would serve torn bytes; the old payload is simply
+        # dropped and freed by GC once the last reader releases it.
+        self.retain_sealed = False
+        self._sealed: dict | None = None
+        # called (no args, outside the lock) after each retention update;
+        # the migration service republishes its advert from here
+        self.on_sealed = None
         self._tl = timeline("ckpt")
         self._stats = {"saves_async": 0, "saves_sync": 0, "superseded": 0,
                        "writes": 0, "errors": 0,
@@ -176,7 +190,9 @@ class CheckpointManager:
                 self._gc(sealed_only=True)
                 return None
             host_state = jax.device_get(state)
-            return self._write_replicated(host_state, status)
+            version = self._write_replicated(host_state, status)
+            self._retain("replicated", host_state, version, status)
+            return version
         finally:
             with self._cond:
                 self._stats["saves_sync"] += 1
@@ -313,9 +329,11 @@ class CheckpointManager:
         # timeout); it drops a poison marker so every rank raises after.
         failure: BaseException | None = None
         my_files: list[str] = []
+        owns_snap = snap is None
         try:
-            my_files = (sc.write_snapshot(tmp, snap) if snap is not None
-                        else sc.save_sharded(tmp, state))
+            if owns_snap:
+                snap = sc.snapshot_shards(state)
+            my_files = sc.write_snapshot(tmp, snap)
         except BaseException as exc:  # noqa: BLE001 — re-raised below
             failure = exc
             try:
@@ -359,6 +377,16 @@ class CheckpointManager:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
+        if owns_snap and self.retain_sealed:
+            # Sync-path retention: snapshot_shards arrays MAY alias live
+            # device buffers (its documented contract), and a donated
+            # train step after this save would overwrite them under an
+            # in-flight peer fetch — copy before retaining. The async
+            # path retains its already-staged arena in the writer loop
+            # instead (no copy needed there).
+            kept = dict(snap, chunks=[(n, np.array(a))
+                                      for n, a in snap["chunks"]])
+            self._retain("sharded", kept, version, status)
         if self.process_index != 0:
             # Non-zero pods never seal versions locally, but restore-time
             # mirror fetches accumulate sealed ckpt-N dirs in their
@@ -491,6 +519,51 @@ class CheckpointManager:
                 log.info("startup GC: removing stale partial save %s", path)
                 shutil.rmtree(path, ignore_errors=True)
 
+    # -- sealed-snapshot retention (state-migration donors) ----------------
+
+    def _retain(self, kind: str, payload: Any, version: int | None,
+                status: TrainStatus) -> None:
+        """Keep the just-sealed save's host payload for peer serving.
+        No-op unless `retain_sealed`. Never recycles the PREVIOUS
+        retained payload into the staging pool (see __init__ note —
+        torn-serve hazard); it is dropped for GC instead."""
+        cb = None
+        with self._cond:
+            if not self.retain_sealed:
+                return
+            self._sealed = {"kind": kind, "payload": payload,
+                            "version": version,
+                            # isolate from the loop's live status cursor
+                            "status": TrainStatus.from_dict(
+                                status.to_dict())}
+            cb = self.on_sealed
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — serving is best-effort;
+                log.exception("on_sealed hook failed")  # never fail a save
+
+    def sealed_snapshot(self) -> dict | None:
+        """Newest sealed save as a serve-ready view — ``{version,
+        status, process_index, leaves, chunks}`` where ``leaves`` is the
+        self-describing chunk table (sharded_checkpoint format) and
+        ``chunks`` maps chunk file names to host arrays. This is the
+        donor manifest+payload the migration server answers peers with.
+        None until a save seals with ``retain_sealed`` set."""
+        with self._cond:
+            rec = self._sealed
+        if rec is None:
+            return None
+        if rec["kind"] == "sharded":
+            snap = rec["payload"]
+        else:
+            snap = sc.snapshot_host_tree(rec["payload"])
+        return {"version": rec["version"],
+                "status": rec["status"].to_dict(),
+                "process_index": snap.get("process_index", 0),
+                "leaves": snap["leaves"],
+                "chunks": dict(snap["chunks"])}
+
     # -- async snapshot-then-write -----------------------------------------
 
     def save_async(self, state: Any, status: TrainStatus) -> None:
@@ -607,10 +680,15 @@ class CheckpointManager:
                 t0 = time.perf_counter()
                 with self._tl.span("write"):
                     if job["kind"] == "sharded":
-                        self._save_sharded(None, job["status"],
-                                           snap=job["snap"])
+                        ver = self._save_sharded(None, job["status"],
+                                                 snap=job["snap"])
+                        self._retain("sharded", job["snap"], ver,
+                                     job["status"])
                     else:
-                        self._write_replicated(job["tree"], job["status"])
+                        ver = self._write_replicated(job["tree"],
+                                                     job["status"])
+                        self._retain("replicated", job["tree"], ver,
+                                     job["status"])
                 dt = time.perf_counter() - t0
                 with self._cond:
                     self._stats["writes"] += 1
@@ -624,7 +702,13 @@ class CheckpointManager:
                     self._stats["errors"] += 1
             finally:
                 with self._cond:
-                    self._recycle_arena(job)
+                    payload = (job.get("snap") if job["kind"] == "sharded"
+                               else job.get("tree"))
+                    if self._sealed is None \
+                            or self._sealed.get("payload") is not payload:
+                        # not retained (or retention replaced it):
+                        # arena returns to the staging pool as before
+                        self._recycle_arena(job)
                     self._inflight = False
                     self._cond.notify_all()
 

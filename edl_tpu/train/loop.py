@@ -92,7 +92,8 @@ class TrainLoop:
                  eval_fn: Callable | None = None,
                  hooks: list[Callable] | None = None,
                  batch_axes: tuple[str, ...] | None = None,
-                 place_state: Callable | None = None):
+                 place_state: Callable | None = None,
+                 on_reform: Callable | None = None):
         self.step_fn = step_fn
         self.state = state
         self.mesh = mesh
@@ -141,6 +142,29 @@ class TrainLoop:
             self._util_publisher = None
         if self._util_publisher is not None:
             self.hooks = list(self.hooks) + [self._util_publisher]
+        # State-migration plane (collective/migration.py): under the
+        # elastic launcher with EDL_TPU_RESIZE_P2P on, this trainer (a)
+        # serves its retained sealed checkpoint snapshot to peers, (b)
+        # prefers restoring from live donors over disk, and (c) adopts
+        # resizes that keep this pod IN PLACE — re-entering the epoch at
+        # the cursor with the new (rank, world) instead of dying into a
+        # stop-resume. `on_reform(rank, world, cluster)` is the caller's
+        # hook to re-derive data sharding for the new world.
+        self.on_reform = on_reform
+        self._migration = None
+        if self.ckpt is not None:
+            try:
+                from edl_tpu.collective.migration import MigrationService
+                self._migration = MigrationService.from_env(self.ckpt)
+            except Exception:  # noqa: BLE001 — the plane is optional;
+                log.warning("migration service unavailable",  # train on
+                            exc_info=True)
+        self.restore_source: str | None = None
+        self.bytes_from_peers = 0
+        self.reforms = 0
+        self.last_reform_downtime_s: float | None = None
+        self.stop_reason: str | None = None
+        self._reform_t0: float | None = None
 
     # -- checkpoint glue ---------------------------------------------------
 
@@ -151,7 +175,28 @@ class TrainLoop:
         # writer are invisible to restore (never sealed) but leak disk
         # forever otherwise — the trainer start path owns the sweep.
         self.ckpt.gc_stale_tmp()
-        restored = self.ckpt.restore(self.state)
+        restored = None
+        if self._migration is not None:
+            # Peer-first restore: live donors serve the state straight
+            # from memory over the tensor wire; disk is only the
+            # fallback. The local disk version is the fence — a stale
+            # donor never beats a newer sealed local checkpoint.
+            from edl_tpu.collective.migration import PeerRestoreError
+            t0 = time.perf_counter()
+            try:
+                state, status, stats = self._migration.restore_from_peers(
+                    self.state, local_version=self.ckpt.latest_version())
+                restored = (state, status)
+                self.restore_source = "peers"
+                self.bytes_from_peers = int(stats["bytes_from_peers"])
+                self.ckpt.last_restore_s = time.perf_counter() - t0
+            except PeerRestoreError as exc:
+                log.info("peer restore unavailable (%s) — falling back "
+                         "to disk", exc)
+        if restored is None:
+            restored = self.ckpt.restore(self.state)
+            if restored is not None:
+                self.restore_source = "disk"
         self.restore_s = self.ckpt.last_restore_s
         if restored is None:
             return False
@@ -181,6 +226,26 @@ class TrainLoop:
         self.ckpt_stall_ms_total += (time.perf_counter() - t0) * 1e3
         self.ckpt_saves += 1
 
+    def _adopt(self, reform) -> None:
+        """Adopt a resize in place: the new cluster still contains this
+        pod, so instead of dying into a stop-resume it re-derives its
+        data shard for the new (rank, world) and keeps the live state on
+        the devices. The measured gap (adoption -> first step of the new
+        generation) is the p2p resize downtime for survivors."""
+        self._reform_t0 = time.perf_counter()
+        log.info("live-reform: adopting cluster v%d rank=%d world=%d in "
+                 "place (no respawn, no restore)", reform.generation,
+                 reform.rank, reform.world_size)
+        if self.on_reform is not None:
+            self.on_reform(reform.rank, reform.world_size, reform.cluster)
+        if self._util_publisher is not None:
+            # the scaler's unit contract: rates must be tagged with the
+            # allocation (pod count) + generation that produced them
+            self._util_publisher.world_size = reform.world_size
+            self._util_publisher.generation = reform.generation
+        self._migration.adopted(reform)
+        self.reforms += 1
+
     def ckpt_stats(self) -> dict:
         """Checkpoint-plane accounting for benchlog extras: loop-side
         stall totals + the manager's snapshot/write/supersede stats."""
@@ -192,6 +257,13 @@ class TrainLoop:
                "ckpt_async": bool(self.config.ckpt_async)}
         if self.restore_s is not None:
             out["ckpt_restore_s"] = round(self.restore_s, 3)
+        # state-migration plane accounting (resize_bench/demo audits)
+        out["restore_source"] = self.restore_source
+        out["bytes_from_peers"] = self.bytes_from_peers
+        out["reforms"] = self.reforms
+        if self.last_reform_downtime_s is not None:
+            out["reform_downtime_s"] = round(
+                self.last_reform_downtime_s, 4)
         if self.ckpt is not None:
             out.update({f"ckpt_{k}": (round(v, 3)
                                       if isinstance(v, float) else v)
@@ -226,7 +298,27 @@ class TrainLoop:
                          self.status.epoch)
                 return self.status
             for epoch in range(start_epoch, cfg.num_epochs):
-                self._run_epoch(epoch, data_fn, batch_size_fn)
+                outcome = self._run_epoch(epoch, data_fn, batch_size_fn)
+                while outcome == "reform":
+                    # In-place adoption: same epoch re-entered at the
+                    # step cursor with the new (rank, world) — the
+                    # mid-epoch resume machinery replays the skip, the
+                    # state never leaves the devices.
+                    outcome = self._run_epoch(epoch, data_fn,
+                                              batch_size_fn)
+                if outcome == "stop":
+                    # Graceful stop (SIGTERM under the launcher): seal
+                    # the live state so the donor linger serves the
+                    # freshest params to the re-formed world, then exit
+                    # 143 — the finally block drains the write and
+                    # lingers. Raising (not returning) matters: an
+                    # example main that returns 0 after run() would
+                    # read to the launcher as "training complete" and
+                    # mark the whole job done off a stray SIGTERM.
+                    log.info("graceful stop at epoch %d step %d",
+                             epoch, self.status.step)
+                    self._save()
+                    raise SystemExit(143)
                 self.status.epoch = epoch
                 self.status.step_in_epoch = 0
                 if (epoch + 1) % max(1, cfg.ckpt_every_epochs) == 0 \
@@ -254,6 +346,14 @@ class TrainLoop:
                 # in-flight exception; clean-path write errors already
                 # surfaced at the epoch-end wait() above.
                 self.ckpt.close(raise_errors=False)
+            if self._migration is not None:
+                # After ckpt.close() so the drained final snapshot is
+                # retained and served: on a graceful stop this lingers
+                # as a donor until the re-formed world acks (bounded).
+                try:
+                    self._migration.shutdown()
+                except Exception:  # noqa: BLE001 — teardown
+                    log.exception("migration shutdown failed")
             # Even on a crash or the already-complete early return, the
             # lease must be revoked so a dead trainer's utilization
             # record expires instead of being kept fresh forever.
@@ -335,6 +435,19 @@ class TrainLoop:
         src = data_fn(epoch)
         it = self._epoch_iter(src, skip)
         for i, batch in it:
+            if self._migration is not None:
+                if self._migration.stop_requested.is_set():
+                    # Graceful stop: leave at the step boundary with the
+                    # cursor intact; run() seals the live state and the
+                    # donor linger takes over.
+                    self.stop_reason = "sigterm"
+                    it.close()
+                    return "stop"
+                reform = self._migration.poll_reform()
+                if reform is not None:
+                    it.close()
+                    self._adopt(reform)
+                    return "reform"
             self._profile_window()
             self.state, metrics = self.step_fn(self.state, batch)
             if not self._first_step_done:
@@ -348,6 +461,25 @@ class TrainLoop:
                          self.status.step + 1,
                          "%.3f" % self.restore_s
                          if self.restore_s is not None else "none")
+                if self._migration is not None:
+                    # restore ack: this pod is trained-and-running —
+                    # what lingering donors and the resize audit key on
+                    self._migration.ack(
+                        self.restore_source or "fresh",
+                        bytes_from_peers=self.bytes_from_peers,
+                        restore_s=self.restore_s)
+            if self._reform_t0 is not None:
+                # First step of the adopted generation: force the
+                # dispatch so the measured gap covers real training
+                # resumption, not an async enqueue.
+                jax.block_until_ready(self.state)
+                gap = time.perf_counter() - self._reform_t0
+                self._reform_t0 = None
+                self.last_reform_downtime_s = gap
+                log.info("reform-step-complete generation=%d "
+                         "downtime_s=%.3f",
+                         self._migration.generation, gap)
+                self._migration.ack("adopted", downtime_s=round(gap, 4))
             self.status.step += 1
             self.status.step_in_epoch = i + 1
             n = (batch_size_fn(batch) if batch_size_fn

@@ -148,24 +148,60 @@ def save_sharded(directory: str, state: Any) -> list[str]:
     return write_snapshot(directory, snapshot_shards(state))
 
 
-def _merged_index(directory: str) -> dict[str, dict]:
-    """key -> {shape, dtype, chunks[]} merged across all process indexes."""
+def snapshot_host_tree(state: Any) -> dict:
+    """Leaf-table + full-array-chunk view of a HOST pytree.
+
+    The replicated checkpoint payload (rank 0's `jax.device_get` tree)
+    expressed in the same self-describing structure `snapshot_shards`
+    emits: every leaf is one chunk covering the whole array, owned by
+    process 0. This is what lets the state-migration plane serve
+    replicated AND sharded snapshots through one region planner —
+    a peer restoring from a replicated donor plans regions against this
+    table exactly as it would against on-disk chunk indexes.
+    """
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    chunks_out: list[tuple[str, np.ndarray]] = []
+    table = []
+    for i, (path, leaf) in enumerate(leaves):
+        arr = np.asarray(leaf)
+        offset = tuple(0 for _ in arr.shape)
+        fname = _chunk_name(i, offset)
+        chunks_out.append((fname, arr))
+        table.append({"key": _leaf_key(path), "shape": list(arr.shape),
+                      "dtype": str(arr.dtype),
+                      "chunks": [{"offset": list(offset),
+                                  "shape": list(arr.shape),
+                                  "file": fname}]})
+    return {"leaves": table, "chunks": chunks_out, "process_index": 0}
+
+
+def merge_leaf_tables(tables: list[list[dict]]) -> dict[str, dict]:
+    """key -> {shape, dtype, chunks[]} merged across per-process leaf
+    tables (the `leaves` list of an index file, a `snapshot_shards`
+    result, or a migration donor's manifest)."""
     merged: dict[str, dict] = {}
-    paths = glob.glob(os.path.join(directory, "index.*.json"))
-    if not paths:
-        raise FileNotFoundError(f"no index.*.json under {directory}")
-    for p in sorted(paths):
-        with open(p) as f:
-            data = json.load(f)
-        for leaf in data["leaves"]:
+    for leaves in tables:
+        for leaf in leaves:
             entry = merged.setdefault(
                 leaf["key"], {"shape": leaf["shape"], "dtype": leaf["dtype"],
                               "chunks": []})
             if entry["shape"] != leaf["shape"]:
                 raise ValueError(
-                    f"shape mismatch across index files for {leaf['key']}")
+                    f"shape mismatch across leaf tables for {leaf['key']}")
             entry["chunks"].extend(leaf["chunks"])
     return merged
+
+
+def _merged_index(directory: str) -> dict[str, dict]:
+    """key -> {shape, dtype, chunks[]} merged across all process indexes."""
+    paths = glob.glob(os.path.join(directory, "index.*.json"))
+    if not paths:
+        raise FileNotFoundError(f"no index.*.json under {directory}")
+    tables = []
+    for p in sorted(paths):
+        with open(p) as f:
+            tables.append(json.load(f)["leaves"])
+    return merge_leaf_tables(tables)
 
 
 class _ChunkFiles:
@@ -194,9 +230,12 @@ class _ChunkFiles:
         self._handles.clear()  # memmaps close when the views are collected
 
 
-def _read_region(files: _ChunkFiles, entry: dict, index: tuple
-                 ) -> np.ndarray:
-    """Assemble the region `index` (tuple of slices) from saved chunks."""
+def _read_region(load, entry: dict, index: tuple) -> np.ndarray:
+    """Assemble the region `index` (tuple of slices) from saved chunks.
+
+    ``load(fname) -> ndarray`` is the chunk source — a `_ChunkFiles`
+    mmap cache for on-disk checkpoints, or a peer-fetch cache when the
+    chunks live in a migration donor's memory."""
     shape = tuple(entry["shape"])
     offset, size = _slices_to_offset_shape(index, shape)
     out = np.empty(size, dtype=np.dtype(entry["dtype"]))
@@ -210,7 +249,7 @@ def _read_region(files: _ChunkFiles, entry: dict, index: tuple
               for o, s, co, cs in zip(offset, size, coff, cshape)]
         if any(a >= b for a, b in zip(lo, hi)):
             continue
-        src = files.load(chunk["file"])
+        src = load(chunk["file"])
         src_sel = tuple(slice(a - co, b - co)
                         for a, b, co in zip(lo, hi, coff))
         dst_sel = tuple(slice(a - o, b - o)
@@ -259,8 +298,24 @@ def restore_sharded(directory: str, target: Any,
     prefetched concurrently before device placement, and 1 keeps the
     serial path.
     """
-    merged = _merged_index(directory)
     files = _ChunkFiles(directory)
+    try:
+        return restore_from_index(_merged_index(directory), files.load,
+                                  target, threads)
+    finally:
+        files.close()
+
+
+def restore_from_index(merged: dict[str, dict], load, target: Any,
+                       threads: int | None = None) -> Any:
+    """The resharding planner behind `restore_sharded`, with the chunk
+    source abstracted: plan every unique (leaf, region) the TARGET's
+    shardings need, read regions through ``load(fname) -> ndarray``
+    (thread-pooled), assemble via `jax.make_array_from_callback`. The
+    state-migration plane drives this with a peer-fetch loader so the
+    SAME planner that reshards on-disk checkpoints reshards donor
+    memory across the wire.
+    """
     if threads is None:
         threads = restore_threads()
     leaves, treedef = jax.tree_util.tree_flatten_with_path(target)
@@ -301,7 +356,7 @@ def restore_sharded(directory: str, target: Any,
 
     def read(entry, idx):
         k = (id(entry), _region_key(idx, tuple(entry["shape"])))
-        regions[k] = _read_region(files, entry, idx)
+        regions[k] = _read_region(load, entry, idx)
 
     jobs = [(entry, idx) for _, entry, _, _, idxs in plans for idx in idxs]
     if threads > 1 and len(jobs) > 1:
@@ -321,7 +376,7 @@ def restore_sharded(directory: str, target: Any,
             def region(idx, e=entry):
                 k = (id(e), _region_key(idx, tuple(e["shape"])))
                 if k not in regions:  # older-jax fallback: no prefetch plan
-                    regions[k] = _read_region(files, e, idx)
+                    regions[k] = _read_region(load, e, idx)
                 return regions[k]
 
             arr = jax.make_array_from_callback(shape, sharding, region)
@@ -331,7 +386,6 @@ def restore_sharded(directory: str, target: Any,
         else:
             full = regions[(id(entry), _region_key(idxs[0], shape))]
             out.append(full if shape else full[()])
-    files.close()
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

@@ -88,6 +88,12 @@ def start_launcher(store_addr, tmp_path, name, epochs=3, step_time=0.05):
         "EDL_TPU_LEASE_TTL": "2.0",
         "EDL_TPU_BARRIER_STABLE": "0.5",
         "EDL_TPU_NODES_RANGE": "1:4",
+        # This suite pins the BASELINE stop-resume recipe (kill world ->
+        # re-form -> restore from disk); with p2p live migration on,
+        # survivors adopt in place and the restart-banner assertions
+        # below would see no restart. The p2p plane has its own suite
+        # (test_state_migration.py + elastic_demo --resize-p2p).
+        "EDL_TPU_RESIZE_P2P": "0",
     })
     return subprocess.Popen(
         [sys.executable, "-m", "edl_tpu.collective.launch", "--",
