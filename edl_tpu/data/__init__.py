@@ -2,6 +2,9 @@ from edl_tpu.data.data_server import DataServer, RemoteSource
 from edl_tpu.data.image import (JpegFileListSource, decode_jpeg,
                                 encode_jpeg, eval_image_transform,
                                 train_image_transform)
+from edl_tpu.data.packed_records import (PackedSource, PackedWriter,
+                                         pack_jpeg_list, pack_npz,
+                                         pack_source)
 from edl_tpu.data.pipeline import (ArraySource, DataLoader, FileSource,
                                    epoch_indices, prefetch,
                                    prefetch_to_device)
@@ -10,8 +13,9 @@ from edl_tpu.data.task_loader import (TaskDataLoader, npz_loader,
 from edl_tpu.data.task_master import TaskMaster, file_list_specs
 
 __all__ = ["ArraySource", "DataLoader", "DataServer", "FileSource",
-           "JpegFileListSource", "RemoteSource", "decode_jpeg",
-           "encode_jpeg", "epoch_indices", "eval_image_transform",
-           "prefetch", "prefetch_to_device", "train_image_transform",
-           "TaskDataLoader", "TaskMaster", "file_list_specs",
-           "npz_loader", "text_loader"]
+           "JpegFileListSource", "PackedSource", "PackedWriter",
+           "RemoteSource", "decode_jpeg", "encode_jpeg", "epoch_indices",
+           "eval_image_transform", "pack_jpeg_list", "pack_npz",
+           "pack_source", "prefetch", "prefetch_to_device",
+           "train_image_transform", "TaskDataLoader", "TaskMaster",
+           "file_list_specs", "npz_loader", "text_loader"]

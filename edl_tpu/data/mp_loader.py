@@ -70,7 +70,7 @@ class _WorkerEnv:
     """Everything a worker needs, inherited through fork (not pickled)."""
 
     def __init__(self, source, sample_transforms, transforms, ring,
-                 task_qs, result_q, stop):
+                 task_qs, result_q, stop, emit_seed=False):
         self.source = source
         self.sample_transforms = sample_transforms
         self.transforms = transforms
@@ -78,6 +78,7 @@ class _WorkerEnv:
         self.task_qs = task_qs
         self.result_q = result_q
         self.stop = stop
+        self.emit_seed = emit_seed
 
 
 def _worker_main(env: _WorkerEnv, wid: int) -> None:
@@ -102,7 +103,8 @@ def _worker_main(env: _WorkerEnv, wid: int) -> None:
         try:
             batch = materialize_batch(env.source, idx,
                                       env.sample_transforms,
-                                      env.transforms, sseeds, bseed)
+                                      env.transforms, sseeds, bseed,
+                                      emit_seed=env.emit_seed)
             meta = shm_ring.write_batch(env.ring.buf(slot), batch)
             # meta=None: batch outgrew the slot (shape drift after the
             # sizing probe) — ship it pickled rather than fail; the
@@ -136,11 +138,15 @@ class MpLoaderPool:
         batch via `shm_ring.batch_nbytes`).
       n_slots: ring depth; default 2*workers+2 keeps every worker busy
         with one task queued each plus reorder slack.
+      emit_seed: attach each descriptor's batch seed to its batch as a
+        0-d uint32 "augment_seed" (the device-augmentation hand-off —
+        DataLoader.emit_batch_seed).
     """
 
     def __init__(self, source, sample_transforms: Sequence[Callable],
                  transforms: Sequence[Callable], num_workers: int,
-                 slot_bytes: int, n_slots: int | None = None):
+                 slot_bytes: int, n_slots: int | None = None,
+                 emit_seed: bool = False):
         if num_workers < 1:
             raise EdlDataError(f"num_workers must be >= 1, got {num_workers}")
         n_slots = n_slots or 2 * num_workers + 2
@@ -155,7 +161,7 @@ class MpLoaderPool:
         self._result_q = ctx.Queue()
         env = _WorkerEnv(source, list(sample_transforms), list(transforms),
                          self.ring, self._task_qs, self._result_q,
-                         self._stop)
+                         self._stop, emit_seed=emit_seed)
         self._procs = [ctx.Process(target=_worker_main, args=(env, wid),
                                    daemon=True,
                                    name=f"edl-mp-loader-{wid}")

@@ -166,6 +166,13 @@ class FileSource:
                     for k, (shape, dtype) in self._meta.items()}
         fis = np.searchsorted(self._starts, idx, side="right") - 1
         locals_ = idx - self._starts[fis]
+        if fis[0] == fis[-1] and (fis == fis[0]).all():
+            # Whole batch inside ONE shard (always true for single-file
+            # sources, common under the permutation's locality): one
+            # fancy-index gather per key, in request order — no
+            # per-part slicing, no second collation buffer.
+            shard = self._shard(int(fis[0]))
+            return {k: v[locals_] for k, v in shard.items()}
         out: dict[str, list] = {}
         # group by file so each shard is touched once per batch
         order = np.argsort(fis, kind="stable")
@@ -191,7 +198,8 @@ def materialize_batch(source, idx: np.ndarray,
                       transforms: Sequence[Callable],
                       sample_seeds: np.ndarray | None,
                       batch_seed: int | None,
-                      pool=None) -> dict[str, np.ndarray]:
+                      pool=None, emit_seed: bool = False
+                      ) -> dict[str, np.ndarray]:
     """Compute one batch from a dispatched descriptor.
 
     THE determinism contract of the loader, shared verbatim by all three
@@ -201,6 +209,13 @@ def materialize_batch(source, idx: np.ndarray,
     transforms), drawn by the parent in step order before dispatch, so
     the batch bytes are a pure function of the descriptor no matter
     where or when it runs.
+
+    `emit_seed` is the device-augmentation hand-off: instead of (or in
+    addition to) consuming `batch_seed` on the host, attach it to the
+    batch as a 0-d uint32 under ``"augment_seed"`` so the jitted
+    on-device augmentation (`ops/augment.py`) folds in the SAME
+    parent-drawn draw — still a pure function of the descriptor, so the
+    bit-identical-stream contract holds per mode.
     """
     if sample_transforms:
         samples = source.samples(idx)
@@ -222,6 +237,10 @@ def materialize_batch(source, idx: np.ndarray,
         brng = np.random.default_rng(batch_seed)
         for t in transforms:
             batch = t(batch, brng)
+    if emit_seed:
+        batch = {**batch,
+                 "augment_seed": np.asarray(batch_seed & 0xFFFFFFFF,
+                                            dtype=np.uint32)}
     return batch
 
 
@@ -272,6 +291,15 @@ class DataLoader:
     and the worker processes and unlinks every shm segment. TrainLoop
     closes the loader it drives; abandoning the object entirely still
     tears the pool down via GC.
+
+    `emit_batch_seed=True` is the DEVICE-augmentation feed
+    (ops/augment.py): the per-step batch seed — the same parent-drawn
+    draw host `transforms` consume — rides each batch as a 0-d uint32
+    under ``"augment_seed"``; `prefetch_to_device(augment=...)` /
+    `TrainLoop(augment_fn=...)` pop it before placement and hand it to
+    the jitted augment, so crop/flip/normalize overlap the step instead
+    of burning host cores.  Works in every execution mode (the seed is
+    part of the descriptor's pure function).
     """
 
     def __init__(self, source, batch_size: int, *, rank: int = 0,
@@ -280,7 +308,8 @@ class DataLoader:
                  transforms: Sequence[Callable] = (),
                  sample_transforms: Sequence[Callable] = (),
                  decode_threads: int = 0,
-                 num_workers: int | None = None):
+                 num_workers: int | None = None,
+                 emit_batch_seed: bool = False):
         if world < 1 or not (0 <= rank < world):
             raise EdlDataError(f"bad shard rank={rank} world={world}")
         if sample_transforms and not hasattr(source, "samples"):
@@ -302,6 +331,7 @@ class DataLoader:
         self.sample_transforms = list(sample_transforms)
         self.decode_threads = decode_threads
         self.num_workers = num_workers
+        self.emit_batch_seed = emit_batch_seed
         self._pool = None
         self._mp_pool = None
         self._mp_finalizer = None
@@ -327,7 +357,8 @@ class DataLoader:
         from edl_tpu.data import mp_loader
         pool = mp_loader.MpLoaderPool(
             self.source, self.sample_transforms, self.transforms,
-            self.num_workers, mp_loader.probe_slot_bytes(probe_batch))
+            self.num_workers, mp_loader.probe_slot_bytes(probe_batch),
+            emit_seed=self.emit_batch_seed)
         self._mp_pool = pool
         # GC of an abandoned DataLoader (or interpreter exit) must still
         # join workers and unlink the shm ring.
@@ -385,7 +416,8 @@ class DataLoader:
                 break
             sseeds = rng.integers(0, 2**63, size=len(idx)) \
                 if self.sample_transforms else None
-            bseed = int(rng.integers(0, 2**63)) if self.transforms else None
+            bseed = int(rng.integers(0, 2**63)) \
+                if self.transforms or self.emit_batch_seed else None
             if i >= start_step:
                 descs.append((i, idx, sseeds, bseed))
         return descs
@@ -403,7 +435,8 @@ class DataLoader:
         for _step, idx, sseeds, bseed in descs:
             yield materialize_batch(self.source, idx,
                                     self.sample_transforms,
-                                    self.transforms, sseeds, bseed, pool)
+                                    self.transforms, sseeds, bseed, pool,
+                                    emit_seed=self.emit_batch_seed)
 
     def _epoch_mp(self, descs) -> Iterator[dict[str, np.ndarray]]:
         if not descs:
@@ -416,7 +449,8 @@ class DataLoader:
             step0, idx0, sseeds0, bseed0 = descs[0]
             probe = materialize_batch(self.source, idx0,
                                       self.sample_transforms,
-                                      self.transforms, sseeds0, bseed0)
+                                      self.transforms, sseeds0, bseed0,
+                                      emit_seed=self.emit_batch_seed)
             yield probe
             pool = self._ensure_mp_pool(probe)
             descs = descs[1:]
@@ -500,25 +534,68 @@ def prefetch(it: Iterable, size: int = 2,
     return gen()
 
 
-def prefetch_to_device(it: Iterable, sharding, size: int = 2) -> Iterator:
-    """Prefetch + device placement: batches land sharded on the mesh while
-    the previous step computes (H2D overlap).
+def place_array(x, sharding):
+    """`jax.device_put` with the ring-view aliasing guard.
 
     Borrowed views (OWNDATA=False — e.g. the mp loader's shm-ring
     batches) are copied before placement: `jax.device_put` zero-copies
     suitably aligned host buffers on the CPU backend (the placed Array
     ALIASES the numpy memory) and DMAs asynchronously on TPU, so placing
     a ring view directly would hand the step memory that a worker
-    process rewrites as soon as the slot recycles."""
+    process rewrites as soon as the slot recycles.  Arrays that OWN
+    their memory (inline-mode batches, `PackedSource` gathers) place
+    without the defensive copy — nobody else holds that buffer."""
+    x = np.asarray(x)
+    if not x.flags["OWNDATA"]:
+        x = np.array(x)
+    return jax.device_put(x, sharding)
 
-    def _place_one(x):
-        x = np.asarray(x)
-        if not x.flags["OWNDATA"]:
-            x = np.array(x)
-        return jax.device_put(x, sharding)
+
+def pop_augment_seed(batch, augment) -> tuple:
+    """Split a loader batch into (payload, seed) for device augmentation.
+
+    The 0-d ``"augment_seed"`` must come OFF the batch before placement
+    (a scalar cannot shard over the mesh's batch axes) and is consumed
+    only by `augment`; a seed with no augment configured — or the
+    reverse — is a wiring bug surfaced here instead of as a cryptic
+    sharding error or a silently never-augmented run."""
+    from edl_tpu.ops.augment import AUGMENT_SEED_KEY
+    has_seed = isinstance(batch, dict) and AUGMENT_SEED_KEY in batch
+    if augment is None:
+        if has_seed:
+            raise EdlDataError(
+                "loader emitted augment_seed but no device augment fn is "
+                "configured (pass augment= / TrainLoop(augment_fn=...), "
+                "or drop DataLoader(emit_batch_seed=True))")
+        return batch, None
+    if not has_seed:
+        raise EdlDataError(
+            "device augment configured but the batch carries no "
+            "augment_seed — construct the DataLoader with "
+            "emit_batch_seed=True")
+    batch = dict(batch)
+    return batch, batch.pop(AUGMENT_SEED_KEY)
+
+
+def prefetch_to_device(it: Iterable, sharding, size: int = 2,
+                       augment: Callable | None = None) -> Iterator:
+    """Prefetch + device placement: batches land sharded on the mesh while
+    the previous step computes (H2D overlap).  See `place_array` for the
+    borrowed-view copy rule.
+
+    `augment` is the device-side augmentation hook (a jitted
+    `(batch, seed) -> batch` from `ops.augment.make_device_augment`):
+    the parent-drawn per-step seed is popped off the batch
+    (`DataLoader(emit_batch_seed=True)`), the raw bytes are placed, and
+    the augment dispatches asynchronously — crop/flip/normalize run on
+    the accelerator UNDER the previous step, costing the host nothing."""
 
     def place(batch):
-        return jax.tree.map(_place_one, batch)
+        batch, seed = pop_augment_seed(batch, augment)
+        placed = jax.tree.map(lambda x: place_array(x, sharding), batch)
+        if augment is not None:
+            placed = augment(placed, seed)
+        return placed
 
     return prefetch(it, size=size, place=place)
 

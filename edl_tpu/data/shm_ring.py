@@ -62,7 +62,12 @@ def write_batch(buf, batch: dict[str, np.ndarray]
     meta = []
     cap = len(buf)
     for k in sorted(batch):
-        arr = np.ascontiguousarray(batch[k])
+        # ascontiguousarray only when needed: it promotes 0-d to (1,)
+        # (the device-augment seed is a 0-d uint32 and must round-trip
+        # shape-intact — same guard as tensor_wire.send_tensors)
+        arr = np.asarray(batch[k])
+        if not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
         if offset + arr.nbytes > cap:
             return None
         _view(buf, arr.shape, arr.dtype, offset)[...] = arr

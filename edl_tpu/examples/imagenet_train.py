@@ -121,13 +121,18 @@ def build_schedule(args, steps_per_epoch: int, world: int) -> optax.Schedule:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="edl_tpu.examples.imagenet_train")
     parser.add_argument("--data-dir", required=True)
-    parser.add_argument("--data-format", choices=("npz", "jpeg"),
+    parser.add_argument("--data-format", choices=("npz", "jpeg", "packed"),
                         default="npz",
                         help="npz: float shards; jpeg: a train.txt "
                              "'<path> <label>' file list of JPEGs with "
                              "host decode + random-resized-crop/flip "
                              "(the reference's reader_cv2 path) and "
-                             "on-device normalization")
+                             "on-device normalization; packed: a "
+                             "train.pack pre-decoded fixed-stride record "
+                             "file (python -m edl_tpu.data.packed_records "
+                             "pack) — the host only gathers raw bytes "
+                             "and augmentation runs on device "
+                             "(--augment-device default on)")
     parser.add_argument("--decode-threads", type=int,
                         default=max(1, (os.cpu_count() or 1) - 1),
                         help="JPEG decode/augment THREAD pool width "
@@ -188,6 +193,16 @@ def main(argv=None) -> int:
     parser.add_argument("--no-augment", action="store_true",
                         help="disable flip/crop transforms (synthetic-label "
                              "tasks are not augmentation-invariant)")
+    parser.add_argument("--augment-device", type=int, default=None,
+                        choices=(0, 1),
+                        help="run crop/flip/normalize as a jitted program "
+                             "ON DEVICE from the loader's per-step seeds "
+                             "(ops/augment.py) instead of host "
+                             "transforms — the host only gathers bytes. "
+                             "npz/packed formats only (jpeg decode is "
+                             "inherently host-side: pack it first). "
+                             "Default: $EDL_TPU_AUGMENT_DEVICE, else on "
+                             "for --data-format packed, off otherwise")
     parser.add_argument("--rotate", action="store_true",
                         help="jpeg mode: +-10 degree random rotation before "
                              "the crop (reference --rotate, img_tool.py)")
@@ -241,6 +256,32 @@ def main(argv=None) -> int:
             f"{args.epochs}: epochs past the horizon would train at "
             "LR ~0 (the horizon is the job TOTAL; the stop point is "
             "--epochs)")
+    # Device-side augmentation: CLI > env > format default (on for
+    # packed — the whole point of packing is a transform-free host).
+    # Resolved BEFORE any rank-dependent code so bad combinations exit
+    # every rank identically.
+    if args.augment_device is not None:
+        augment_device = bool(args.augment_device)
+    else:
+        env_aug = os.environ.get("EDL_TPU_AUGMENT_DEVICE")
+        augment_device = (env_aug.lower() in ("1", "true", "yes", "on")
+                          if env_aug is not None
+                          else args.data_format == "packed")
+    if args.no_augment:
+        augment_device = False
+    if augment_device and args.data_format == "jpeg":
+        raise SystemExit(
+            "--augment-device needs fixed-stride pre-decoded pixels and "
+            "jpeg decode is inherently host-side — pack the list first: "
+            "python -m edl_tpu.data.packed_records pack --jpeg-list "
+            "train.txt --root DATA --out DATA/train.pack, then "
+            "--data-format packed")
+    if augment_device and args.teachers:
+        raise SystemExit(
+            "--augment-device is not supported with --teachers (the "
+            "distill reader ships the teacher the SAME pixels the "
+            "student trains on; device-augmented pixels never exist on "
+            "host)")
     distributed.force_platform_from_env()
     env = distributed.init_from_env()
     world = max(1, env.world_size)
@@ -258,6 +299,16 @@ def main(argv=None) -> int:
                                   args.num_classes, args.seed,
                                   signal=args.synthetic_signal,
                                   label_noise=args.synthetic_label_noise)
+            if args.data_format == "packed":
+                # pack the freshly-written float shards (dtypes
+                # preserved); val stays val.npz — eval reads it directly
+                from edl_tpu.data.packed_records import pack_npz
+                shards = sorted(
+                    os.path.join(args.data_dir, f)
+                    for f in os.listdir(args.data_dir)
+                    if f.startswith("train-") and f.endswith(".npz"))
+                pack_npz(shards,
+                         os.path.join(args.data_dir, "train.pack"))
     if args.make_synthetic and jax.process_count() > 1:
         # non-writers must not listdir a half-written data dir
         from jax.experimental import multihost_utils
@@ -313,17 +364,37 @@ def main(argv=None) -> int:
         normalize = "imagenet"  # uint8 off the wire; normalize on chip
         n_files = len(source)
     else:
-        files = sorted(os.path.join(args.data_dir, f)
-                       for f in os.listdir(args.data_dir)
-                       if f.startswith("train-") and f.endswith(".npz"))
-        if not files:
-            raise SystemExit(f"no train-*.npz shards under {args.data_dir}")
-        source = FileSource(files)
-        transforms = () if args.no_augment else (random_flip_lr, random_crop)
+        if args.data_format == "packed":
+            from edl_tpu.data.packed_records import PackedSource
+            pack_path = os.path.join(args.data_dir, "train.pack")
+            if not os.path.exists(pack_path):
+                raise SystemExit(
+                    f"no train.pack under {args.data_dir} (pack one: "
+                    "python -m edl_tpu.data.packed_records pack)")
+            source = PackedSource(pack_path)
+            # pre-decoded uint8 (the jpeg-packed path) normalizes like
+            # the jpeg plane; float shards were normalized at pack time
+            if source.fields["image"][1] == np.uint8:
+                normalize = "imagenet"
+            n_files = 1
+        else:
+            files = sorted(os.path.join(args.data_dir, f)
+                           for f in os.listdir(args.data_dir)
+                           if f.startswith("train-") and f.endswith(".npz"))
+            if not files:
+                raise SystemExit(
+                    f"no train-*.npz shards under {args.data_dir}")
+            source = FileSource(files)
+            n_files = len(files)
+        # device augmentation replaces the host batch transforms: the
+        # loader ships raw bytes + the per-step seed, and the SAME
+        # crop/flip (+ normalize) runs jitted after placement
+        transforms = () if (args.no_augment or augment_device) \
+            else (random_flip_lr, random_crop)
         loader = DataLoader(source, local_bs, rank=rank, world=world,
                             seed=args.seed, transforms=transforms,
-                            num_workers=loader_workers)
-        n_files = len(files)
+                            num_workers=loader_workers,
+                            emit_batch_seed=augment_device)
     steps_per_epoch = loader.steps_per_epoch()
     log.info("world=%d rank=%d devices=%d format=%s shards=%d samples=%d "
              "steps/epoch=%d", world, rank, jax.device_count(),
@@ -384,18 +455,40 @@ def main(argv=None) -> int:
             compress_topk=args.distill_topk,
             sparse_predicts=bool(args.distill_topk))
     else:
-        step = make_classification_step(args.num_classes,
-                                        smoothing=args.label_smoothing,
-                                        mixup_alpha=args.mixup_alpha,
-                                        seed=args.seed,
-                                        normalize=normalize)
+        step = make_classification_step(
+            args.num_classes, smoothing=args.label_smoothing,
+            mixup_alpha=args.mixup_alpha, seed=args.seed,
+            # with device augmentation the augment op normalizes (one
+            # fused uint8->float pass after crop/flip); the step must
+            # not normalize twice
+            normalize=None if augment_device else normalize)
     eval_step = make_eval_step(normalize=normalize)
+    augment = None
+    if augment_device:
+        from edl_tpu.ops.augment import make_device_augment
+        augment = make_device_augment(pad=4, base_seed=args.seed,
+                                      normalize=normalize)
+        log.info("device-side augmentation: crop(pad=4)+flip+normalize "
+                 "jitted on device from loader-emitted per-step seeds")
 
     # eval_batches: None, or a zero-arg callable yielding {'image',
     # 'label'} host batches of local_bs (streamed — a 50k-image val set
     # must not be decoded serially into one giant resident array)
     eval_batches = None
-    if args.data_format == "jpeg":
+    val_pack = os.path.join(args.data_dir, "val.pack")
+    if args.data_format == "packed" and os.path.exists(val_pack):
+        from edl_tpu.data.packed_records import PackedSource
+        vsrc = PackedSource(val_pack)
+        if len(vsrc) >= local_bs:
+            def _packed_eval_batches():
+                for lo in range(0, len(vsrc) - local_bs + 1, local_bs):
+                    yield vsrc.batch(np.arange(lo, lo + local_bs))
+
+            eval_batches = _packed_eval_batches
+        else:
+            log.warning("val.pack has %d < batch %d rows — eval off",
+                        len(vsrc), local_bs)
+    elif args.data_format == "jpeg":
         val_list = os.path.join(args.data_dir, "val.txt")
         if os.path.exists(val_list):
             vsrc = JpegFileListSource(val_list, root=args.data_dir)
@@ -445,9 +538,15 @@ def main(argv=None) -> int:
         epoch_t0[0] = time.perf_counter()
         return results
 
+    # Single-process: the augment applies inside prefetch_to_device's
+    # staging thread (dispatched under the running step). Multi-process:
+    # batches reach TrainLoop._place as host arrays (form_global_batch),
+    # so the loop pops the seed and augments after forming the global
+    # batch — exactly one of the two paths owns the seed.
     loop = TrainLoop(
         step, state, mesh=mesh, config=loop_cfg, eval_fn=eval_fn,
-        place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t))
+        place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t),
+        augment_fn=augment if jax.process_count() > 1 else None)
 
     def data_fn(epoch):
         if distill_reader is not None:
@@ -455,7 +554,7 @@ def main(argv=None) -> int:
             it = distill_reader()
         else:
             it = loader.epoch(epoch)
-        return prefetch_to_device(it, data_sharding) \
+        return prefetch_to_device(it, data_sharding, augment=augment) \
             if jax.process_count() == 1 else it
 
     # TrainLoop closes the data plane it drives (decode pool / mp

@@ -1,4 +1,11 @@
+from edl_tpu.ops.augment import (AUGMENT_SEED_KEY, apply_crop,
+                                 apply_flip_lr, host_crop_flip_decisions,
+                                 make_device_augment, mixup,
+                                 normalize_image)
 from edl_tpu.ops.flash_attention import flash_attention
 from edl_tpu.ops.fused_xent import streamed_lm_xent
 
-__all__ = ["flash_attention", "streamed_lm_xent"]
+__all__ = ["AUGMENT_SEED_KEY", "apply_crop", "apply_flip_lr",
+           "flash_attention", "host_crop_flip_decisions",
+           "make_device_augment", "mixup", "normalize_image",
+           "streamed_lm_xent"]

@@ -1,0 +1,159 @@
+"""Device-side batch augmentation: jitted crop/flip/normalize (+ mixup).
+
+The other half of the packed-records feed path (`data/packed_records.py`):
+the host ships raw fixed-stride bytes and the augmentation that used to
+burn host cores (`pipeline.random_flip_lr` / `random_crop`) runs as a
+jitted program on the accelerator, dispatched right after placement so
+it overlaps the previous step instead of gating batch production.
+
+Seed contract — the loader's determinism invariant extended on-device:
+
+- The parent draws ONE batch seed per step, in step order, from the
+  per-(epoch, rank) generator — exactly the draw the host batch
+  transforms consume today (`DataLoader._epoch_descriptors`).  With
+  `DataLoader(emit_batch_seed=True)` that same draw rides the batch as
+  a 0-d uint32 under ``AUGMENT_SEED_KEY`` (through the inline, thread
+  and shm-ring mp paths unchanged — it is part of the descriptor's pure
+  function, so all modes stay bit-identical).
+- The device op folds it in: ``key = jax.random.fold_in(PRNGKey(
+  base_seed), batch_seed)``; decisions are drawn (flip, y, x) in the
+  SAME order as the host pipeline draws them.
+- Host<->device equivalence is at the TRANSFORM level: given the same
+  decisions, host and device produce bit-identical pixels
+  (`apply_flip_lr` / `apply_crop` vs `random_flip_lr` / `random_crop`,
+  asserted by tests/test_packed_records.py).  The decision BITS differ
+  by backend — numpy's PCG64 and jax's Threefry are different
+  generators — so host-augmented and device-augmented runs are two
+  distinct-but-equally-distributed deterministic streams, each exactly
+  replayable from (seed, epoch, rank, step).  `host_crop_flip_decisions`
+  replays the host pipeline's draws for the equivalence test and for
+  anyone who needs to reproduce one stream on the other backend.
+
+Mixup was already device-side (derived from `fold_in(seed, state.step)`
+inside the jitted step); it lives here now with the rest of the
+augmentation ops and `train/classification.py` re-exports it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AUGMENT_SEED_KEY = "augment_seed"
+
+# Per-channel ImageNet statistics (reference img_tool.py:116-117), scaled
+# to the uint8 range because pixels ship as 1 byte/channel and normalize
+# ON DEVICE (the DALI recipe: float32 pixels would 4x the H2D bytes).
+IMAGENET_MEAN = (0.485 * 255.0, 0.456 * 255.0, 0.406 * 255.0)
+IMAGENET_STD = (0.229 * 255.0, 0.224 * 255.0, 0.225 * 255.0)
+
+
+def normalize_image(images: jax.Array, mode: str | None) -> jax.Array:
+    """On-device pixel normalization for uint8 NHWC batches.
+
+    None: passthrough (floats already normalized on host — the npz path);
+    'imagenet': per-channel (x - mean)/std with the reference's
+    constants; 'unit': x*(2/255) - 1."""
+    if mode is None:
+        return images
+    if mode == "imagenet":
+        mean = jnp.asarray(IMAGENET_MEAN, jnp.float32)
+        std = jnp.asarray(IMAGENET_STD, jnp.float32)
+        return (images.astype(jnp.float32) - mean) / std
+    if mode == "unit":
+        return images.astype(jnp.float32) * (2.0 / 255.0) - 1.0
+    raise ValueError(f"unknown normalize mode {mode!r}")
+
+
+def mixup(key: jax.Array, images: jax.Array, targets: jax.Array,
+          alpha: float) -> tuple[jax.Array, jax.Array]:
+    """Mixup a batch with a Beta(alpha, alpha) coefficient.
+
+    One lambda per batch (the reference's recipe) + a random permutation of
+    the batch as the mixing partner. Static shapes; jit-safe.
+    """
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.beta(k1, alpha, alpha)
+    perm = jax.random.permutation(k2, images.shape[0])
+    mixed_x = lam * images + (1.0 - lam) * images[perm]
+    mixed_y = lam * targets + (1.0 - lam) * targets[perm]
+    return mixed_x.astype(images.dtype), mixed_y
+
+
+# -- transform appliers (decision -> pixels; shared by the jitted augment
+#    and the host-equivalence test) ----------------------------------------
+
+def apply_flip_lr(images: jax.Array, flip: jax.Array) -> jax.Array:
+    """Per-sample horizontal flip (NHWC) by boolean mask — the device
+    twin of `pipeline.random_flip_lr`'s `out[flip] = out[flip, :, ::-1]`."""
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :],
+                     images)
+
+
+def apply_crop(images: jax.Array, ys: jax.Array, xs: jax.Array,
+               pad: int) -> jax.Array:
+    """Pad-and-crop (NHWC) at per-sample (y, x) offsets — the device twin
+    of `pipeline.random_crop` (same reflect padding, same window)."""
+    n, h, w, c = images.shape
+    padded = jnp.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                     mode="reflect")
+
+    def one(img, y, x):
+        return jax.lax.dynamic_slice(img, (y, x, 0), (h, w, c))
+
+    return jax.vmap(one)(padded, ys, xs)
+
+
+def host_crop_flip_decisions(batch_seed: int, n: int, pad: int = 4
+                             ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Replay the HOST pipeline's augmentation draws for one batch:
+    `transforms=(random_flip_lr, random_crop)` consumes the per-step
+    generator as flip (n uniforms), then ys, then xs — in that order.
+    Feeding these to `apply_flip_lr`/`apply_crop` reproduces the host
+    stream bit-for-bit (the equivalence contract's test hook)."""
+    rng = np.random.default_rng(batch_seed)
+    flip = rng.random(n) < 0.5
+    ys = rng.integers(0, 2 * pad + 1, size=n)
+    xs = rng.integers(0, 2 * pad + 1, size=n)
+    return flip, ys.astype(np.int32), xs.astype(np.int32)
+
+
+# -- the jitted augment ------------------------------------------------------
+
+def make_device_augment(*, pad: int = 4, flip: bool = True,
+                        crop: bool = True, normalize: str | None = None,
+                        base_seed: int = 0, image_key: str = "image"
+                        ) -> Callable:
+    """Jitted `(batch, seed) -> batch` device augmentation.
+
+    `seed` is the parent-drawn per-step batch seed (a 0-d uint32 — what
+    `DataLoader(emit_batch_seed=True)` attaches and
+    `prefetch_to_device(augment=...)` / `TrainLoop` pop off the batch
+    before placement); it is folded into ``PRNGKey(base_seed)`` so two
+    jobs with different base seeds draw independent streams from the
+    same loader.  Decisions draw in host order (flip, y, x).  The
+    returned batch replaces `image_key` (normalized if `normalize`) and
+    carries every other key through untouched.
+    """
+
+    @jax.jit
+    def augment(batch: dict, seed: jax.Array) -> dict:
+        images = batch[image_key]
+        n = images.shape[0]
+        key = jax.random.fold_in(jax.random.PRNGKey(base_seed), seed)
+        k_flip, k_y, k_x = jax.random.split(key, 3)
+        if flip:
+            images = apply_flip_lr(images,
+                                   jax.random.uniform(k_flip, (n,)) < 0.5)
+        if crop:
+            ys = jax.random.randint(k_y, (n,), 0, 2 * pad + 1)
+            xs = jax.random.randint(k_x, (n,), 0, 2 * pad + 1)
+            images = apply_crop(images, ys, xs, pad)
+        images = normalize_image(images, normalize)
+        return {**{k: v for k, v in batch.items() if k != image_key},
+                image_key: images}
+
+    return augment

@@ -20,32 +20,14 @@ import jax
 import jax.numpy as jnp
 import optax
 
+# The on-device pixel ops live with the rest of the device-side
+# augmentation plane (ops/augment.py, the packed-records feed path);
+# re-exported here because every step builder and its callers import
+# them from this module.
+from edl_tpu.ops.augment import (IMAGENET_MEAN, IMAGENET_STD,  # noqa: F401
+                                 mixup, normalize_image)
 from edl_tpu.train.state import TrainState
 from edl_tpu.train.step import make_train_step
-
-
-# Per-channel ImageNet statistics (reference img_tool.py:116-117), scaled
-# to the uint8 range because pixels ship as 1 byte/channel and normalize
-# ON DEVICE (the DALI recipe: float32 pixels would 4x the H2D bytes).
-IMAGENET_MEAN = (0.485 * 255.0, 0.456 * 255.0, 0.406 * 255.0)
-IMAGENET_STD = (0.229 * 255.0, 0.224 * 255.0, 0.225 * 255.0)
-
-
-def normalize_image(images: jax.Array, mode: str | None) -> jax.Array:
-    """On-device pixel normalization for uint8 NHWC batches.
-
-    None: passthrough (floats already normalized on host — the npz path);
-    'imagenet': per-channel (x - mean)/std with the reference's
-    constants; 'unit': x*(2/255) - 1."""
-    if mode is None:
-        return images
-    if mode == "imagenet":
-        mean = jnp.asarray(IMAGENET_MEAN, jnp.float32)
-        std = jnp.asarray(IMAGENET_STD, jnp.float32)
-        return (images.astype(jnp.float32) - mean) / std
-    if mode == "unit":
-        return images.astype(jnp.float32) * (2.0 / 255.0) - 1.0
-    raise ValueError(f"unknown normalize mode {mode!r}")
 
 
 def smoothed_labels(labels: jax.Array, num_classes: int,
@@ -68,21 +50,6 @@ def distill_kl(student_logits: jax.Array, teacher_logits: jax.Array,
     t = temperature
     teacher = jax.nn.softmax(teacher_logits / t)
     return soft_cross_entropy(student_logits / t, teacher) * t * t
-
-
-def mixup(key: jax.Array, images: jax.Array, targets: jax.Array,
-          alpha: float) -> tuple[jax.Array, jax.Array]:
-    """Mixup a batch with a Beta(alpha, alpha) coefficient.
-
-    One lambda per batch (the reference's recipe) + a random permutation of
-    the batch as the mixing partner. Static shapes; jit-safe.
-    """
-    k1, k2 = jax.random.split(key)
-    lam = jax.random.beta(k1, alpha, alpha)
-    perm = jax.random.permutation(k2, images.shape[0])
-    mixed_x = lam * images + (1.0 - lam) * images[perm]
-    mixed_y = lam * targets + (1.0 - lam) * targets[perm]
-    return mixed_x.astype(images.dtype), mixed_y
 
 
 def accuracy_topk(logits: jax.Array, labels: jax.Array, k: int = 1

@@ -73,6 +73,14 @@ class LoopConfig:
     # --loader-workers CLI flag is not given; DataLoader itself also
     # honors the same env var when num_workers is left unset.
     loader_workers: int = field(0, env="EDL_TPU_LOADER_WORKERS")
+    # Device-side augmentation (ops/augment.py): the loader ships raw
+    # packed/npz bytes + the parent-drawn per-step seed and jitted
+    # crop/flip/normalize runs on the accelerator, overlapping the step
+    # instead of burning host cores. Entrypoints read this to build the
+    # loader with emit_batch_seed=True and hand TrainLoop an augment_fn
+    # (imagenet_train --augment-device); 0 = host transforms, the
+    # unchanged fallback path.
+    augment_device: bool = field(False, env="EDL_TPU_AUGMENT_DEVICE")
 
 
 class TrainLoop:
@@ -93,10 +101,16 @@ class TrainLoop:
                  hooks: list[Callable] | None = None,
                  batch_axes: tuple[str, ...] | None = None,
                  place_state: Callable | None = None,
-                 on_reform: Callable | None = None):
+                 on_reform: Callable | None = None,
+                 augment_fn: Callable | None = None):
         self.step_fn = step_fn
         self.state = state
         self.mesh = mesh
+        # Device-side augmentation hook (ops.augment.make_device_augment):
+        # `(placed_batch, seed) -> batch`, applied after placement with
+        # the per-step seed the loader emitted (emit_batch_seed=True) —
+        # the jitted dispatch overlaps the running step.
+        self.augment_fn = augment_fn
         # Re-places a restored host-side state pytree onto devices (required
         # in a multi-process world where host numpy can't feed a global-mesh
         # jit directly — e.g. mesh_lib.replicate_host_tree, or a sharded
@@ -273,12 +287,27 @@ class TrainLoop:
     # -- main loop ---------------------------------------------------------
 
     def _place(self, batch):
-        if self.mesh is None:
-            return batch
-        # form_global_batch degenerates to shard_batch in a single-process
-        # world; in a multi-process world it treats the fed batch as this
-        # process's slice of the global batch (multipod contract).
-        return mesh_lib.form_global_batch(self.mesh, batch, self.batch_axes)
+        # Device augmentation: the loader-emitted per-step seed comes off
+        # the batch BEFORE placement (a 0-d scalar can't shard over the
+        # batch axes); the jitted augment applies after. Batches already
+        # augmented upstream (prefetch_to_device(augment=...)) carry no
+        # seed and pass through; a seed with no augment_fn (or the
+        # reverse) raises a wiring error instead of mis-sharding.
+        seed = None
+        if self.augment_fn is not None or (isinstance(batch, dict)
+                                           and "augment_seed" in batch):
+            from edl_tpu.data.pipeline import pop_augment_seed
+            batch, seed = pop_augment_seed(batch, self.augment_fn)
+        if self.mesh is not None:
+            # form_global_batch degenerates to shard_batch in a
+            # single-process world; in a multi-process world it treats
+            # the fed batch as this process's slice of the global batch
+            # (multipod contract).
+            batch = mesh_lib.form_global_batch(self.mesh, batch,
+                                               self.batch_axes)
+        if self.augment_fn is not None:
+            batch = self.augment_fn(batch, seed)
+        return batch
 
     def run(self, data_fn: Callable[[int], Iterable],
             batch_size_fn: Callable[[Any], int] | None = None) -> TrainStatus:

@@ -1,0 +1,429 @@
+"""Packed pre-decoded records + device-side augmentation
+(data/packed_records.py + ops/augment.py).
+
+The acceptance surface of the zero-host-transform feed path:
+- pack -> read round-trip is byte-identical to the source,
+- random access is O(1) over an mmap (construction reads only the
+  header; sample pages are read lazily at access time),
+- `PackedSource` flows through every DataLoader execution mode with
+  bit-identical streams, including the emitted device-augment seed,
+- the mid-epoch replay cursor works from a packed file,
+- host and device augmentation are equivalent at the transform level
+  (same decisions -> bit-identical pixels) and each pipeline is exactly
+  replayable from its seed,
+- truncated/corrupt files raise a clear error instead of garbage
+  batches,
+- `place_array` skips the defensive copy for owned arrays and keeps it
+  for borrowed ring views; no per-sample Python loop runs for a packed
+  batch.
+"""
+
+import contextlib
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from edl_tpu.data import packed_records as pr
+from edl_tpu.data.pipeline import (DataLoader, FileSource, materialize_batch,
+                                   pop_augment_seed, prefetch_to_device,
+                                   random_crop, random_flip_lr)
+from edl_tpu.utils.exceptions import EdlDataError
+
+
+@contextlib.contextmanager
+def deadline(seconds: int):
+    """Fail (don't hang) if the block exceeds `seconds`."""
+
+    def fire(signum, frame):
+        raise TimeoutError(f"test exceeded {seconds}s deadline")
+
+    old = signal.signal(signal.SIGALRM, fire)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def copy_stream(it):
+    return [{k: np.array(v) for k, v in b.items()} for b in it]
+
+
+def assert_streams_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert set(x) == set(y)
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k])
+
+
+@pytest.fixture(scope="module")
+def npz_dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("packed_npz")
+    rng = np.random.default_rng(0)
+    files = []
+    for i in range(3):
+        path = str(d / f"train-{i}.npz")
+        np.savez(path,
+                 image=rng.integers(0, 256, size=(20, 10, 10, 3),
+                                    dtype=np.uint8),
+                 label=rng.integers(0, 10, size=20).astype(np.int32))
+        files.append(path)
+    return files
+
+
+@pytest.fixture(scope="module")
+def packed_file(npz_dataset, tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("packed") / "train.pack")
+    pr.pack_npz(npz_dataset, out, batch_size=13)
+    return out
+
+
+class TestFormat:
+    def test_pack_roundtrip_byte_equality(self, npz_dataset, packed_file):
+        src = pr.PackedSource(packed_file)
+        ref = FileSource(npz_dataset)
+        assert len(src) == len(ref) == 60
+        idx = np.random.default_rng(1).permutation(60)
+        got, want = src.batch(idx), ref.batch(idx)
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k])
+            assert got[k].dtype == want[k].dtype
+
+    def test_jpeg_pack_matches_eval_decode(self, tmp_path):
+        """Packing a jpeg list bakes exactly the deterministic eval
+        geometry (decode -> resize-short -> center-crop)."""
+        cv2 = pytest.importorskip("cv2")  # noqa: F841 — decode engine
+        from edl_tpu.data.image import (JpegFileListSource,
+                                        eval_image_transform,
+                                        make_synthetic_jpeg_dataset)
+        d = str(tmp_path)
+        list_file = make_synthetic_jpeg_dataset(d, 10, classes=5,
+                                                hw=(40, 50), seed=3)
+        out = os.path.join(d, "t.pack")
+        pr.pack_jpeg_list(list_file, d, out, size=16, batch_size=4)
+        src = pr.PackedSource(out)
+        assert src.fields["image"] == ((16, 16, 3), np.dtype(np.uint8))
+        jsrc = JpegFileListSource(list_file, root=d)
+        t = eval_image_transform(16, short=16 * 8 // 7)
+        idx = np.array([7, 0, 3])
+        want = [t(s, None) for s in jsrc.samples(idx)]
+        got = src.batch(idx)
+        for i in range(len(idx)):
+            np.testing.assert_array_equal(got["image"][i], want[i]["image"])
+            assert got["label"][i] == want[i]["label"]
+
+    def test_random_access_is_lazy_mmap(self, tmp_path):
+        """Construction reads only the header: bytes rewritten on disk
+        AFTER the source is built are what a later batch() returns —
+        proof the sample tables are faulted in lazily, not preloaded."""
+        out = str(tmp_path / "t.pack")
+        img = np.arange(8 * 4 * 4 * 3, dtype=np.uint8).reshape(8, 4, 4, 3)
+        with pr.PackedWriter(out, 8, {"image": ((4, 4, 3), np.uint8),
+                                      "label": ((), np.int32)}) as w:
+            w.add({"image": img, "label": np.arange(8, dtype=np.int32)})
+        src = pr.PackedSource(out)
+        header = pr.read_header(out)
+        row = int(np.prod(img.shape[1:]))
+        with open(out, "r+b") as f:  # rewrite row 5 behind the mmap
+            f.seek(header["fields"]["image"]["offset"] + 5 * row)
+            f.write(b"\xff" * row)
+        got = src.batch(np.array([5, 2]))
+        np.testing.assert_array_equal(
+            got["image"][0], np.full((4, 4, 3), 255, np.uint8))
+        np.testing.assert_array_equal(got["image"][1], img[2])
+
+    def test_batch_owns_contiguous_memory(self, packed_file):
+        b = pr.PackedSource(packed_file).batch(np.array([3, 1, 59]))
+        for v in b.values():
+            assert v.flags["OWNDATA"] and v.flags["C_CONTIGUOUS"]
+            assert type(v) is np.ndarray  # not a memmap subclass
+
+    def test_empty_index_gives_empty_typed_batch(self, packed_file):
+        b = pr.PackedSource(packed_file).batch(np.array([], dtype=np.intp))
+        assert b["image"].shape == (0, 10, 10, 3)
+        assert b["label"].dtype == np.int32
+
+
+class TestCorruption:
+    def test_not_a_packed_file(self, tmp_path):
+        p = str(tmp_path / "x.pack")
+        with open(p, "wb") as f:
+            f.write(b"definitely not a packed file")
+        with pytest.raises(EdlDataError, match="bad magic"):
+            pr.PackedSource(p)
+
+    def test_truncated_tables(self, packed_file, tmp_path):
+        p = str(tmp_path / "trunc.pack")
+        with open(packed_file, "rb") as f, open(p, "wb") as g:
+            g.write(f.read(pr.HEADER_BLOCK + 64))
+        with pytest.raises(EdlDataError, match="truncated"):
+            pr.PackedSource(p)
+
+    def test_corrupt_header_json(self, packed_file, tmp_path):
+        p = str(tmp_path / "garbage.pack")
+        with open(packed_file, "rb") as f:
+            blob = bytearray(f.read())
+        blob[16:32] = b"\xff" * 16  # stomp the JSON
+        with open(p, "wb") as g:
+            g.write(blob)
+        with pytest.raises(EdlDataError, match="corrupt"):
+            pr.PackedSource(p)
+
+    def test_writer_refuses_underfill_and_overflow(self, tmp_path):
+        fields = {"x": ((2,), np.float32)}
+        w = pr.PackedWriter(str(tmp_path / "a.pack"), 4, fields)
+        w.add({"x": np.zeros((2, 2), np.float32)})
+        with pytest.raises(EdlDataError, match="closed at 2/4"):
+            w.close()
+        w = pr.PackedWriter(str(tmp_path / "b.pack"), 2, fields)
+        with pytest.raises(EdlDataError, match="overflow"):
+            w.add({"x": np.zeros((3, 2), np.float32)})
+        with pytest.raises(EdlDataError, match="fixed-stride"):
+            w.add({"x": np.zeros((1, 5), np.float32)})
+
+
+class TestLoaderModes:
+    """One packed file, three executors, one bit-identical stream —
+    including the emitted device-augment seed."""
+
+    @pytest.mark.parametrize("mode", [dict(decode_threads=2),
+                                      dict(num_workers=1),
+                                      dict(num_workers=2)])
+    def test_stream_bit_identical_with_seeds(self, packed_file, mode):
+        src = pr.PackedSource(packed_file)
+        with deadline(120):
+            with DataLoader(src, 8, seed=5, emit_batch_seed=True) as ld:
+                want = copy_stream(ld.epoch(2))
+            with DataLoader(src, 8, seed=5, emit_batch_seed=True,
+                            **mode) as ld:
+                got = copy_stream(ld.epoch(2))
+        assert "augment_seed" in want[0]
+        assert want[0]["augment_seed"].shape == ()
+        assert want[0]["augment_seed"].dtype == np.uint32
+        assert_streams_equal(want, got)
+
+    def test_mid_epoch_cursor_replays_remainder(self, packed_file):
+        src = pr.PackedSource(packed_file)
+        with deadline(120):
+            with DataLoader(src, 8, seed=9, emit_batch_seed=True) as ld:
+                full = copy_stream(ld.epoch(3))
+            with DataLoader(src, 8, seed=9, emit_batch_seed=True,
+                            num_workers=2) as ld:
+                it = ld.epoch(3)
+                head = [{k: np.array(v) for k, v in next(it).items()}
+                        for _ in range(2)]
+                it.close()  # stop-resume abandons mid-epoch
+                tail = copy_stream(ld.epoch(3, start_step=2))
+        assert_streams_equal(head + tail, full)
+
+    def test_seed_stream_matches_host_transform_draws(self, packed_file):
+        """The emitted seed IS the draw host transforms would consume:
+        same generator, same step order (truncated to uint32)."""
+        src = pr.PackedSource(packed_file)
+        with DataLoader(src, 8, seed=4, emit_batch_seed=True) as ld:
+            seeds = [int(b["augment_seed"]) for b in ld.epoch(1)]
+            descs = ld._epoch_descriptors(1, 0)
+        assert seeds == [b & 0xFFFFFFFF for _, _, _, b in descs]
+
+    def test_no_per_sample_python_loop_for_packed(self, packed_file):
+        """materialize_batch must pass a packed batch straight through:
+        one source.batch() call, no samples()/np.stack re-collation."""
+        src = pr.PackedSource(packed_file)
+        calls = []
+
+        class Spy:
+            def __len__(self):
+                return len(src)
+
+            def batch(self, idx):
+                calls.append(len(idx))
+                return src.batch(idx)
+            # no .samples attribute: a per-sample path would AttributeError
+
+        out = materialize_batch(Spy(), np.arange(8), [], [], None,
+                                12345, emit_seed=True)
+        assert calls == [8]
+        assert out["image"].flags["OWNDATA"]
+        assert int(out["augment_seed"]) == 12345
+
+
+class TestFileSourceFastPath:
+    def test_single_shard_batch_identical_to_multi(self, npz_dataset):
+        src = FileSource(npz_dataset)
+        within = np.array([5, 19, 0, 7])       # all inside shard 0
+        across = np.array([5, 25, 41, 0])      # spans all three shards
+        with np.load(npz_dataset[0]) as z:
+            ref0 = {k: z[k][within] for k in z.files}
+        got = src.batch(within)
+        for k in ref0:
+            np.testing.assert_array_equal(got[k], ref0[k])
+        # the general path still collates correctly across shards
+        whole = FileSource(npz_dataset).batch(np.arange(60))
+        got2 = src.batch(across)
+        for k in whole:
+            np.testing.assert_array_equal(got2[k], whole[k][across])
+
+
+class TestDeviceAugment:
+    def test_host_device_transform_equivalence(self, npz_dataset):
+        """The contract the design doc documents: given the SAME
+        decisions, host transforms and device appliers produce
+        bit-identical pixels — and host_crop_flip_decisions replays
+        exactly the host pipeline's per-step draws."""
+        from edl_tpu.ops.augment import (apply_crop, apply_flip_lr,
+                                         host_crop_flip_decisions)
+        batch = FileSource(npz_dataset).batch(np.arange(12))
+        bseed = 987654321
+        brng = np.random.default_rng(bseed)
+        want = random_crop(random_flip_lr(batch, brng), brng, pad=4)
+        flip, ys, xs = host_crop_flip_decisions(bseed, 12, pad=4)
+        got = np.asarray(apply_crop(
+            apply_flip_lr(batch["image"], flip), ys, xs, 4))
+        np.testing.assert_array_equal(got, want["image"])
+        assert got.dtype == want["image"].dtype
+
+    def test_jitted_augment_deterministic_and_seed_sensitive(
+            self, npz_dataset):
+        import jax.numpy as jnp
+        from edl_tpu.ops.augment import make_device_augment
+        batch = FileSource(npz_dataset).batch(np.arange(8))
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        aug = make_device_augment(pad=3, normalize="imagenet", base_seed=1)
+        a = np.asarray(aug(jb, np.uint32(7))["image"])
+        b = np.asarray(aug(jb, np.uint32(7))["image"])
+        c = np.asarray(aug(jb, np.uint32(8))["image"])
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+        assert a.dtype == np.float32  # normalized on device
+        # labels ride through untouched
+        np.testing.assert_array_equal(
+            np.asarray(aug(jb, np.uint32(7))["label"]), batch["label"])
+
+    def test_normalize_matches_step_constants(self):
+        import jax.numpy as jnp
+        from edl_tpu.ops.augment import normalize_image
+        from edl_tpu.train import classification as cls
+        x = jnp.asarray(np.random.default_rng(0).integers(
+            0, 256, size=(2, 4, 4, 3), dtype=np.uint8))
+        np.testing.assert_allclose(
+            np.asarray(normalize_image(x, "imagenet")),
+            np.asarray(cls.normalize_image(x, "imagenet")))
+        assert cls.IMAGENET_MEAN[0] == pytest.approx(0.485 * 255.0)
+
+    def test_prefetch_to_device_pops_seed_and_augments(self, packed_file):
+        import jax
+        from edl_tpu.ops.augment import make_device_augment
+        from edl_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": 8}))
+        sharding = mesh_lib.data_sharding(mesh)
+        src = pr.PackedSource(packed_file)
+        aug = make_device_augment(pad=2, normalize="unit", base_seed=0)
+        with deadline(120), DataLoader(src, 8, seed=1,
+                                       emit_batch_seed=True,
+                                       num_workers=2) as ld:
+            got = [jax.device_get(b) for b in prefetch_to_device(
+                ld.epoch(0), sharding, augment=aug)]
+        assert got and all("augment_seed" not in b for b in got)
+        assert all(b["image"].dtype == np.float32 for b in got)
+        # replay: the same (seed, epoch) stream augments identically
+        with deadline(120), DataLoader(src, 8, seed=1,
+                                       emit_batch_seed=True) as ld:
+            again = [jax.device_get(b) for b in prefetch_to_device(
+                ld.epoch(0), sharding, augment=aug)]
+        assert_streams_equal(got, again)
+
+    def test_wiring_errors_are_clear(self, packed_file):
+        from edl_tpu.ops.augment import make_device_augment
+        src = pr.PackedSource(packed_file)
+        aug = make_device_augment()
+        with pytest.raises(EdlDataError, match="no device augment fn"):
+            pop_augment_seed({"image": np.zeros(1),
+                              "augment_seed": np.uint32(0)}, None)
+        with pytest.raises(EdlDataError, match="emit_batch_seed"):
+            pop_augment_seed({"image": np.zeros(1)}, aug)
+        # and through the real pipeline: seed emitted, no augment given
+        from edl_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": 8}))
+        with DataLoader(src, 8, seed=1, emit_batch_seed=True) as ld:
+            it = prefetch_to_device(ld.epoch(0),
+                                    mesh_lib.data_sharding(mesh))
+            with pytest.raises(EdlDataError, match="augment_seed"):
+                next(it)
+            it.close()
+
+
+class TestPlacementCopyPolicy:
+    """Satellite: place_array skips the defensive copy for arrays that
+    OWN their memory and keeps it for borrowed (ring-view) arrays."""
+
+    def _capture_device_put(self, monkeypatch):
+        from edl_tpu.data import pipeline
+        seen = []
+
+        def fake_put(x, sharding):
+            seen.append(x)
+            return x
+
+        monkeypatch.setattr(pipeline.jax, "device_put", fake_put)
+        return seen
+
+    def test_owned_array_places_without_copy(self, monkeypatch,
+                                             packed_file):
+        from edl_tpu.data.pipeline import place_array
+        seen = self._capture_device_put(monkeypatch)
+        batch = pr.PackedSource(packed_file).batch(np.arange(4))
+        place_array(batch["image"], sharding=None)
+        assert seen[0] is batch["image"]  # the very same buffer
+
+    def test_borrowed_view_is_copied_before_placement(self, monkeypatch):
+        from edl_tpu.data import shm_ring
+        from edl_tpu.data.pipeline import place_array
+        seen = self._capture_device_put(monkeypatch)
+        batch = {"x": np.arange(32, dtype=np.uint8)}
+        ring = shm_ring.ShmRing(shm_ring.batch_nbytes(batch), 1)
+        try:
+            meta = shm_ring.write_batch(ring.buf(0), batch)
+            view = shm_ring.read_batch(ring.buf(0), meta)["x"]
+            assert not view.flags["OWNDATA"]
+            place_array(view, sharding=None)
+            assert seen[0] is not view
+            assert seen[0].flags["OWNDATA"]
+            np.testing.assert_array_equal(seen[0], batch["x"])
+            del view
+        finally:
+            ring.close()
+
+
+class TestTrainLoopIntegration:
+    def test_loop_drives_packed_device_augment_end_to_end(
+            self, packed_file):
+        import jax
+        from edl_tpu.ops.augment import make_device_augment
+        from edl_tpu.parallel import mesh as mesh_lib
+        from edl_tpu.train.loop import LoopConfig, TrainLoop
+        mesh = mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": 8}))
+        src = pr.PackedSource(packed_file)
+        aug = make_device_augment(pad=2, normalize="unit", base_seed=3)
+        seen = []
+
+        def step(state, batch):
+            assert "augment_seed" not in batch
+            seen.append(jax.device_get(batch["image"]))
+            return state, {"loss": 0.0}
+
+        ld = DataLoader(src, 8, seed=2, emit_batch_seed=True,
+                        num_workers=1)
+        with deadline(120):
+            loop = TrainLoop(step, state=0, mesh=mesh,
+                             config=LoopConfig(num_epochs=1,
+                                               log_every_steps=1000),
+                             augment_fn=aug)
+            loop.run(ld)
+        assert len(seen) == ld.steps_per_epoch()
+        assert all(b.dtype == np.float32 for b in seen)
+        assert ld._mp_pool is None  # run()'s finally closed the loader

@@ -1,12 +1,23 @@
-"""One-shot input-plane tuner: img/s at loader workers in {0, 1, 2, 4}.
+"""One-shot input-plane tuner: worker sweep + source x augment table.
 
-Local sizing companion to the mp shared-memory loader
-(edl_tpu/data/mp_loader.py): generates a synthetic JPEG dataset, runs
-the decode + random-resized-crop + flip plane at each worker count and
-prints a small table, so picking `--loader-workers` /
-`EDL_TPU_LOADER_WORKERS` for a host is one command instead of a sweep
-by hand.  workers=0 is the inline path; pass --decode-threads to also
-see the thread-pool variant at width 0.
+Local sizing companion to the host input plane (edl_tpu/data/):
+generates a synthetic JPEG dataset and
+
+1. runs the decode + random-resized-crop + flip plane at each
+   `--workers` count (the mp shared-memory loader sweep — pick
+   `--loader-workers` / `EDL_TPU_LOADER_WORKERS` for a host), then
+2. prints a `source ∈ {jpeg, npz, packed} × augment ∈ {host, device}`
+   markdown table of HOST-side throughput.  Per-core framing
+   (`img/s/core`, the bench extra `loader_imgs_per_sec_per_core`):
+   multi-worker speedup is host-size-dependent, per-core rate is not —
+   and on a 1-core host it is the only honest number.  The `device`
+   rows ship raw bytes + the parent-drawn per-step seed
+   (`DataLoader(emit_batch_seed=True)`); crop/flip/normalize run jitted
+   on the accelerator (`ops/augment.py`), costing the host nothing —
+   so a device row measures the whole host cost of that feed.  jpeg ×
+   device is not a thing: decode is inherently host work — pack first
+   (`python -m edl_tpu.data.packed_records pack`), which is exactly
+   what the packed rows measure.
 
   python tools/loader_bench.py --n-imgs 256 --size 128 --batches 4
 """
@@ -19,6 +30,8 @@ import shutil
 import sys
 import tempfile
 import time
+
+import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:  # `python tools/loader_bench.py` puts tools/
@@ -52,6 +65,62 @@ def measure(loader, batches: int, batch_size: int) -> float:
     return n / dt
 
 
+def source_augment_table(d: str, list_file: str, args) -> None:
+    """The `source x augment` host-throughput table (markdown)."""
+    from edl_tpu.data.image import JpegFileListSource, train_image_transform
+    from edl_tpu.data.packed_records import (PackedSource, pack_jpeg_list)
+    from edl_tpu.data.pipeline import (DataLoader, FileSource, random_crop,
+                                       random_flip_lr)
+
+    size = args.size
+    # npz shards: crop-sized uint8 images (the host npz plane pads +
+    # crops back to size, the device plane does the same on chip)
+    rng = np.random.default_rng(0)
+    npz_files = []
+    per_shard = max(1, args.n_imgs // 2)
+    for i in range(2):
+        path = os.path.join(d, f"bench-{i}.npz")
+        np.savez(path,
+                 image=rng.integers(0, 256, size=(per_shard, size, size, 3),
+                                    dtype=np.uint8),
+                 label=rng.integers(0, 100, per_shard).astype(np.int32))
+        npz_files.append(path)
+    pack_path = os.path.join(d, "train.pack")
+    pack_jpeg_list(list_file, d, pack_path, size=size,
+                   batch_size=args.batch_size)
+
+    host_t = (random_flip_lr, random_crop)
+    jpeg_src = JpegFileListSource(list_file, root=d)
+    combos = [
+        ("jpeg", "host", lambda: DataLoader(
+            jpeg_src, args.batch_size,
+            sample_transforms=(train_image_transform(size),))),
+        ("npz", "host", lambda: DataLoader(
+            FileSource(npz_files), args.batch_size, transforms=host_t)),
+        ("npz", "device", lambda: DataLoader(
+            FileSource(npz_files), args.batch_size, emit_batch_seed=True)),
+        ("packed", "host", lambda: DataLoader(
+            PackedSource(pack_path), args.batch_size, transforms=host_t)),
+        ("packed", "device", lambda: DataLoader(
+            PackedSource(pack_path), args.batch_size,
+            emit_batch_seed=True)),
+    ]
+    cores = os.cpu_count() or 1
+    print(f"\nhost img/s by source x augment (crop {size}px, batch "
+          f"{args.batch_size}, {cores} core(s); device rows = raw-byte "
+          "gather + emitted seed, augmentation rides the accelerator)\n")
+    print("| source | augment | host img/s | img/s/core | vs jpeg+host |")
+    print("|--------|---------|-----------:|-----------:|-------------:|")
+    base = None
+    for src_name, aug, make in combos:
+        rate = measure(make(), args.batches, args.batch_size)
+        base = base if base is not None else rate
+        # single-threaded production: per-core rate IS the rate
+        print(f"| {src_name} | {aug} | {rate:.1f} | {rate:.1f} "
+              f"| {rate / base:.2f}x |")
+    print("| jpeg | device | — | — | pack first (packed rows) |")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tools/loader_bench.py")
     parser.add_argument("--n-imgs", type=int, default=256)
@@ -64,6 +133,10 @@ def main(argv=None) -> int:
                         default=[0, 1, 2, 4])
     parser.add_argument("--decode-threads", type=int, default=0,
                         help="thread pool width for the workers=0 row")
+    parser.add_argument("--no-table", action="store_true",
+                        help="skip the source x augment table")
+    parser.add_argument("--no-sweep", action="store_true",
+                        help="skip the worker sweep")
     args = parser.parse_args(argv)
 
     from edl_tpu.data.image import (JpegFileListSource,
@@ -79,17 +152,20 @@ def main(argv=None) -> int:
         src = JpegFileListSource(list_file, root=d)
         print(f"host cores: {os.cpu_count()}  images: {args.n_imgs}  "
               f"crop: {args.size}px  batch: {args.batch_size}")
-        print(f"{'workers':>8} {'img/s':>10} {'vs workers=0':>13}")
-        base = None
-        for w in args.workers:
-            loader = DataLoader(
-                src, args.batch_size,
-                sample_transforms=(train_image_transform(args.size),),
-                decode_threads=args.decode_threads if w == 0 else 0,
-                num_workers=w)
-            rate = measure(loader, args.batches, args.batch_size)
-            base = base if base is not None else rate
-            print(f"{w:>8} {rate:>10.1f} {rate / base:>12.2f}x")
+        if not args.no_sweep:
+            print(f"{'workers':>8} {'img/s':>10} {'vs workers=0':>13}")
+            base = None
+            for w in args.workers:
+                loader = DataLoader(
+                    src, args.batch_size,
+                    sample_transforms=(train_image_transform(args.size),),
+                    decode_threads=args.decode_threads if w == 0 else 0,
+                    num_workers=w)
+                rate = measure(loader, args.batches, args.batch_size)
+                base = base if base is not None else rate
+                print(f"{w:>8} {rate:>10.1f} {rate / base:>12.2f}x")
+        if not args.no_table:
+            source_augment_table(d, list_file, args)
     finally:
         shutil.rmtree(d, ignore_errors=True)
     return 0
