@@ -1246,6 +1246,156 @@ def bench_scaler(on_tpu: bool) -> dict:
         "scaler_per_curve": per_curve}
 
 
+def bench_control_plane(on_tpu: bool) -> dict:
+    """Event-driven control plane (ISSUE 8): watch streams vs polling.
+
+    Three measurements, one artifact:
+      - store_watch_latency_ms: PUT -> watcher-callback through the real
+        TCP server + ClientWatch (median of 20), i.e. how fast a
+        membership change reaches a consumer;
+      - control_plane_reqs_per_idle_min: store requests during an IDLE
+        window from a representative consumer set (4 ServiceWatchers +
+        a blocked lock waiter), measured in poll mode
+        (EDL_TPU_COORD_WATCH=0 — every consumer on its original loop)
+        and watch mode in the same run; the ratio is the idle-load
+        collapse (O(pods x poll rate) -> O(changes));
+      - scaler_reaction_ms: fresh-utilization PUT -> decision-journal
+        entry with the fallback interval at 30s, proving the scaler is
+        no longer quantized to its tick.
+    Host-side control plane: identical on every platform."""
+    del on_tpu
+    import threading
+
+    from edl_tpu.coord.client import StoreClient
+    from edl_tpu.coord.lock import DistributedLock
+    from edl_tpu.coord.registry import ServiceRegistry
+    from edl_tpu.coord.server import StoreServer
+    from edl_tpu.coord.store import InMemStore
+
+    idle_s = 3.0
+    saved = os.environ.get("EDL_TPU_COORD_WATCH")
+
+    def _idle_ops_per_min(watch_on: bool) -> float:
+        os.environ["EDL_TPU_COORD_WATCH"] = "1" if watch_on else "0"
+        store = InMemStore()
+        with StoreServer(port=0, host="127.0.0.1", store=store,
+                         sweep_interval=0.5) as srv:
+            client = StoreClient(f"127.0.0.1:{srv.port}")
+            registry = ServiceRegistry(client, root="bench")
+            for i in range(2):
+                registry.register_permanent("svc", f"h:{i}")
+            watchers = [registry.watch_service("svc", interval=1.0)
+                        for _ in range(4)]
+            holder = DistributedLock(client, "/bench/lock", "holder",
+                                     ttl=10.0)
+            holder.try_acquire()
+
+            def _wait_for_lock():
+                # one BLOCKED waiter (the satellite's StoreLock shape):
+                # wakes on the holder's DELETE at teardown
+                waiter = DistributedLock(client, "/bench/lock", "waiter",
+                                         ttl=10.0)
+                if waiter.acquire(timeout=idle_s + 15.0, poll=0.2):
+                    waiter.release()
+            waiter_thread = threading.Thread(target=_wait_for_lock,
+                                             daemon=True)
+            waiter_thread.start()
+            time.sleep(0.5)  # let subscriptions/initial syncs settle
+            ops0 = store.op_count
+            time.sleep(idle_s)
+            ops = store.op_count - ops0
+            for w in watchers:
+                w.stop()
+            holder.release()
+            waiter_thread.join(timeout=10.0)
+            client.close()
+        return ops * (60.0 / idle_s)
+
+    def _watch_latency_ms() -> float:
+        os.environ["EDL_TPU_COORD_WATCH"] = "1"
+        lat = []
+        with StoreServer(port=0, host="127.0.0.1",
+                         sweep_interval=0.5) as srv:
+            client = StoreClient(f"127.0.0.1:{srv.port}")
+            registry = ServiceRegistry(client, root="bench")
+            seen = threading.Event()
+            watcher = registry.watch_service(
+                "lat", on_add=lambda m: seen.set(),
+                on_update=lambda m: seen.set(), interval=30.0)
+            for i in range(20):
+                seen.clear()
+                t0 = time.perf_counter()
+                registry.register_permanent("lat", "h:1", info=str(i))
+                assert seen.wait(5.0), "watch callback never fired"
+                lat.append((time.perf_counter() - t0) * 1e3)
+            watcher.stop()
+            client.close()
+        lat.sort()
+        return lat[len(lat) // 2]
+
+    def _scaler_reaction_ms() -> tuple[float, float]:
+        os.environ["EDL_TPU_COORD_WATCH"] = "1"
+        from edl_tpu.coord.collector import util_key
+        from edl_tpu.scaler.controller import ScalerConfig, ScalerController
+        from edl_tpu.scaler.policy import Proposal
+
+        class _Hold:
+            def decide(self, views, now):
+                return [Proposal(v.job_id, v.world_size, v.world_size,
+                                 "hold") for v in views]
+
+            def restore(self, entries):
+                pass
+
+            def notify_resized(self, job_id, world, now):
+                pass
+
+        store = InMemStore()
+        config = ScalerConfig()
+        config.interval = 30.0
+        config.min_tick_s = 0.0
+        ctl = ScalerController(store, ["bjob"], _Hold(), config=config,
+                               dry_run=True, elect=False)
+        ctl.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while not ctl.journal.tail() \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            n0 = len(ctl.journal.tail())
+            t0 = time.perf_counter()
+            store.put(util_key("bjob", "pod0"), json.dumps(
+                {"examples_per_sec": 100.0, "published_unix": time.time(),
+                 "world_size": 1}))
+            while len(ctl.journal.tail()) == n0 \
+                    and time.perf_counter() - t0 < 20.0:
+                time.sleep(0.01)
+            reaction = (time.perf_counter() - t0) * 1e3
+        finally:
+            ctl.stop()
+        return reaction, config.interval
+
+    try:
+        latency_ms = _watch_latency_ms()
+        poll_rpm = _idle_ops_per_min(watch_on=False)
+        watch_rpm = _idle_ops_per_min(watch_on=True)
+        reaction_ms, interval_s = _scaler_reaction_ms()
+    finally:
+        if saved is None:
+            os.environ.pop("EDL_TPU_COORD_WATCH", None)
+        else:
+            os.environ["EDL_TPU_COORD_WATCH"] = saved
+    return {
+        "store_watch_latency_ms": round(latency_ms, 2),
+        "control_plane_reqs_per_idle_min_poll": round(poll_rpm, 1),
+        "control_plane_reqs_per_idle_min": round(watch_rpm, 1),
+        "control_plane_watch_reduction_x": round(
+            poll_rpm / max(watch_rpm, 1e-9), 1),
+        "scaler_reaction_ms": round(reaction_ms, 1),
+        "scaler_fallback_interval_s": interval_s,
+    }
+
+
 def distill_quality_extras() -> dict:
     """Surface the flagship distill QUALITY measurement (the reference's
     acc1 77.1->79.0 story) from the newest committed artifact —
@@ -1285,6 +1435,7 @@ def main() -> None:
             downtime["elastic_downtime_s"]
             / p2p["elastic_downtime_p2p_s"], 1)
     scaler = bench_scaler(on_tpu)
+    control_plane = bench_control_plane(on_tpu)
     cores_to_feed_jpeg = (resnet["imgs_per_sec"]
                           / max(loader["imgs_per_sec_per_core"], 1e-9))
     # the headline feed question, recomputed against the packed +
@@ -1417,6 +1568,11 @@ def main() -> None:
             # ticks-to-converge / vs-oracle gap / downtime paid across
             # concave+flat+knee curves (edl_tpu/scaler)
             **scaler,
+            # event-driven control plane: PUT -> watcher-callback
+            # latency over TCP, idle store request volume poll- vs
+            # watch-mode (same consumer set), and the scaler's
+            # fresh-util -> decision reaction vs its fallback interval
+            **control_plane,
             # flagship distill QUALITY (committed artifact; see
             # tools/distill_quality_tpu.py)
             **distill_quality_extras(),

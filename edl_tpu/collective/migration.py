@@ -446,6 +446,7 @@ class MigrationService:
         # reform watch
         self._reform: Reform | None = None
         self._watch_thread: threading.Thread | None = None
+        self._reform_watch = None
         self._ckpt = None
 
     # -- construction ------------------------------------------------------
@@ -560,10 +561,31 @@ class MigrationService:
     def _watch_loop(self, interval: float) -> None:
         from edl_tpu.collective import register as reg
         from edl_tpu.collective.cluster import Cluster
+        from edl_tpu.coord.store import try_watch, watch_resync_interval
+        # Event-driven: wake on the leader's cluster-snapshot PUT so an
+        # in-place adoption starts at event latency (the 0.061s p2p
+        # resize path stops waiting out a poll tick); the fixed poll
+        # survives as the resync net / EDL_TPU_COORD_WATCH=0 fallback.
+        key = reg.cluster_key(self.job_id)
+        watch = try_watch(self.store, key)
+        with self._lock:
+            self._reform_watch = watch
+        wait = interval if watch is None \
+            else watch_resync_interval(default=max(interval * 10, 10.0))
         parsed_revision = -1
-        while not self._stop.wait(interval):
+        first = True
+        while not self._stop.is_set():
+            if first:
+                first = False  # a reform published BEFORE the watch
+                # existed has no event: check once immediately
+            elif watch is not None:
+                watch.get(timeout=wait)  # event or resync tick
+                if self._stop.is_set():
+                    return
+            elif self._stop.wait(interval):
+                return
             try:
-                rec = self.store.get(reg.cluster_key(self.job_id))
+                rec = self.store.get(key)
             except Exception as exc:  # noqa: BLE001 — transient store
                 log.debug("reform watch poll failed: %s", exc)
                 continue
@@ -690,6 +712,11 @@ class MigrationService:
                 log.exception("donor linger failed")
         self._stop.set()
         self.server.stop()
+        with self._lock:
+            reform_watch = self._reform_watch
+            self._reform_watch = None
+        if reform_watch is not None:
+            reform_watch.cancel()  # wakes the blocked event wait
         for t in (self._advert_thread, self._watch_thread):
             if t is not None:
                 t.join(timeout=2.0)
@@ -715,25 +742,39 @@ def wait_adopted(store: Store, job_id: str, pod_id: str, generation: int,
                  is_alive: Callable[[], bool] | None = None) -> bool:
     """Launcher side of in-place adoption: block until this pod's
     trainer acked generation >= `generation` (True), the trainer died,
-    or the timeout passed (False -> fall back to stop-resume)."""
+    or the timeout passed (False -> fall back to stop-resume). Wakes on
+    the ack key's PUT event when the store serves watches (the check
+    itself stays poll-shaped so EDL_TPU_COORD_WATCH=0 is identical)."""
+    from edl_tpu.coord.store import try_watch
+    watch = try_watch(store, ack_key(job_id, pod_id))
     deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if is_alive is not None and not is_alive():
-            return False
-        try:
-            rec = store.get(ack_key(job_id, pod_id))
-        except Exception:  # noqa: BLE001 — transient store error
-            rec = None
-        if rec is not None:
+    try:
+        while time.monotonic() < deadline:
+            if is_alive is not None and not is_alive():
+                return False
             try:
-                doc = json.loads(rec.value)
-                if doc.get("mode") == "adopted" \
-                        and int(doc.get("generation") or 0) >= generation:
-                    return True
-            except (ValueError, TypeError):
-                pass
-        time.sleep(poll)
-    return False
+                rec = store.get(ack_key(job_id, pod_id))
+            except Exception:  # noqa: BLE001 — transient store error
+                rec = None
+            if rec is not None:
+                try:
+                    doc = json.loads(rec.value)
+                    if doc.get("mode") == "adopted" \
+                            and int(doc.get("generation") or 0) >= generation:
+                        return True
+                except (ValueError, TypeError):
+                    pass
+            remaining = deadline - time.monotonic()
+            if watch is not None:
+                # the ack PUT wakes us instantly; the bounded timeout
+                # keeps the is_alive check fresh
+                watch.get(timeout=max(0.0, min(0.5, remaining)))
+            else:
+                time.sleep(max(0.0, min(poll, remaining)))
+        return False
+    finally:
+        if watch is not None:
+            watch.cancel()
 
 
 def publish_resize_epoch(store: Store, job_id: str, *, epoch: int,

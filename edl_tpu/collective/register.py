@@ -72,25 +72,39 @@ class PodRegister:
 
     def claim(self, timeout: float = 60.0) -> int:
         """Race for the smallest free slot. Returns the claimed rank."""
+        from edl_tpu.coord.store import try_watch
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            lease = self.store.lease_grant(self.ttl)
-            for i in range(self.max_nodes):
-                self.pod.claimed_rank = i
-                if self.store.put_if_absent(rank_key(self.job_id, i),
-                                            self.pod.to_json(), lease=lease):
-                    self.lease = lease
-                    self._keeper = LeaseKeeper(
-                        self.store, lease, interval=self.ttl / 6.0,
-                        on_lost=self._on_lost).start()
-                    log.info("pod %s claimed rank %d", self.pod.pod_id, i)
-                    return i
-            # Every slot taken: revoke the unused lease and retry — a slot
-            # may free when a pod departs.
-            self.store.lease_revoke(lease)
-            time.sleep(1.0)
-        raise EdlRegisterError(
-            f"no free rank slot in {self.max_nodes} after {timeout}s")
+        watch = None
+        try:
+            while time.monotonic() < deadline:
+                lease = self.store.lease_grant(self.ttl)
+                for i in range(self.max_nodes):
+                    self.pod.claimed_rank = i
+                    if self.store.put_if_absent(rank_key(self.job_id, i),
+                                                self.pod.to_json(),
+                                                lease=lease):
+                        self.lease = lease
+                        self._keeper = LeaseKeeper(
+                            self.store, lease, interval=self.ttl / 6.0,
+                            on_lost=self._on_lost).start()
+                        log.info("pod %s claimed rank %d",
+                                 self.pod.pod_id, i)
+                        return i
+                # Every slot taken: revoke the unused lease and retry when
+                # a slot frees (its DELETE event wakes us; the 1s re-poll
+                # is the EDL_TPU_COORD_WATCH=0 / in-process fallback).
+                self.store.lease_revoke(lease)
+                if watch is None:
+                    watch = try_watch(self.store, ranks_prefix(self.job_id))
+                if watch is not None:
+                    watch.get(timeout=1.0)
+                else:
+                    time.sleep(1.0)
+            raise EdlRegisterError(
+                f"no free rank slot in {self.max_nodes} after {timeout}s")
+        finally:
+            if watch is not None:
+                watch.cancel()
 
     def _on_lost(self) -> None:
         log.error("pod %s lost its rank lease", self.pod.pod_id)

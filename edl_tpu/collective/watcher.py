@@ -1,7 +1,11 @@
 """Cluster membership watcher: sets a flag when the live pod set diverges.
 
 Capability of the reference's Watcher (utils/watcher.py:39-77: thread polls
-the etcd pod service each second, diffs pod JSON, sets `changed`).
+the etcd pod service each second, diffs pod JSON, sets `changed`) — now fed
+by store watch events (rank-claim prefix + published cluster snapshot), so a
+membership change or generation bump is seen at event latency instead of up
+to one poll period later; the periodic re-check survives as a resync safety
+net (and as the whole mechanism when EDL_TPU_COORD_WATCH=0).
 """
 
 from __future__ import annotations
@@ -34,23 +38,62 @@ class ClusterWatcher:
         self.interval = interval
         self.changed = threading.Event()
         self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._watches: list = []
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"cluster-watch-{baseline.job_id}")
 
     def start(self) -> "ClusterWatcher":
+        # Event-driven: a mutation on the rank-claim prefix or the
+        # published cluster snapshot wakes the checker immediately, so
+        # membership changes are seen at event latency; the fixed-period
+        # poll is demoted to a resync safety net. try_watch -> None
+        # (EDL_TPU_COORD_WATCH=0 / redis) keeps the original poll loop.
+        from edl_tpu.coord.store import try_watch
+        job_id = self.baseline.job_id
+        for prefix in (reg.ranks_prefix(job_id), reg.cluster_key(job_id)):
+            watch = try_watch(self.store, prefix)
+            if watch is not None:
+                thread = threading.Thread(target=self._pump, args=(watch,),
+                                          daemon=True,
+                                          name=f"cluster-watch-pump-{job_id}")
+                thread.start()
+                self._watches.append((watch, thread))
         self._thread.start()
         return self
 
+    def _pump(self, watch) -> None:
+        while not self._stop.is_set():
+            batch = watch.get(timeout=5.0)
+            if batch is None:
+                if watch.cancelled:
+                    return
+                continue
+            if batch.events or batch.compacted:
+                self._wake.set()
+
     def _run(self) -> None:
+        from edl_tpu.coord.store import watch_resync_interval
         base = self.baseline.pod_ids()
         version = self.baseline.version
         parsed_revision = -1
-        while not self._stop.wait(self.interval):
+        # with watches the periodic re-check is only a safety net
+        wait = self.interval if not self._watches \
+            else watch_resync_interval(default=max(self.interval * 10, 10.0))
+        first = True
+        while not self._stop.is_set():
+            if first:
+                first = False  # a change between the baseline snapshot
+                # and watch creation has no event: check immediately
+            else:
+                self._wake.wait(timeout=wait)
+                self._wake.clear()
+            if self._stop.is_set():
+                return
             try:
                 pods, _ = reg.live_pods(self.store, self.baseline.job_id)
                 rec = self.store.get(reg.cluster_key(self.baseline.job_id))
-                # Parse the snapshot only when its store revision moved —
-                # this poll runs every second on every pod.
+                # Parse the snapshot only when its store revision moved.
                 if rec is not None and rec.revision != parsed_revision:
                     version = Cluster.from_json(rec.value).version
                     parsed_revision = rec.revision
@@ -71,4 +114,10 @@ class ClusterWatcher:
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()
+        for watch, _ in self._watches:
+            watch.cancel()
+        for _, thread in self._watches:
+            thread.join(timeout=2.0)
+        self._watches = []
         self._thread.join(timeout=2.0)

@@ -1,4 +1,5 @@
-from edl_tpu.coord.store import InMemStore, Record, Event, Store
+from edl_tpu.coord.store import (Event, InMemStore, Record, Store, Watch,
+                                 WatchBatch, try_watch, watch_enabled)
 from edl_tpu.coord.client import StoreClient
 from edl_tpu.coord.lock import DistributedLock, LeaderElection
 from edl_tpu.coord.redis_store import RedisStore, connect_store
@@ -21,6 +22,10 @@ __all__ = [
     "InMemStore",
     "Record",
     "Event",
+    "Watch",
+    "WatchBatch",
+    "try_watch",
+    "watch_enabled",
     "StoreClient",
     "StoreServer",
     "RedisStore",

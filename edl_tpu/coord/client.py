@@ -14,8 +14,10 @@ import socket
 import threading
 import time
 
+from collections import deque
+
 from edl_tpu.coord import wire
-from edl_tpu.coord.store import Event, Record, Store
+from edl_tpu.coord.store import Event, Record, Store, Watch, WatchBatch
 from edl_tpu.utils import exceptions
 from edl_tpu.utils.exceptions import EdlStoreError
 from edl_tpu.utils.logging import get_logger
@@ -147,12 +149,166 @@ class StoreClient(Store):
         return ([Event(*e) for e in resp["events"]], resp["revision"],
                 resp["compacted"])
 
+    def watch(self, prefix: str = "", start_revision: int | None = None,
+              heartbeat: float = 2.0) -> "ClientWatch":
+        """Long-lived watch stream on its own connection (the main
+        socket stays strict request/response). Reconnects on any error
+        and resumes from the last delivered revision, so events are
+        delivered exactly once across server restarts — unless the
+        server compacted past the resume point, in which case the
+        consumer receives an explicit ``compacted`` batch."""
+        return ClientWatch(self, prefix, start_revision,
+                           heartbeat=heartbeat)
+
     def ping(self) -> bool:
         try:
             self._call(op="ping")
             return True
         except EdlStoreError:
             return False
+
+
+class ClientWatch(Watch):
+    """Client half of a watch stream: dedicated socket + reader thread.
+
+    The reader pushes event/compacted batches into a local queue
+    (heartbeat frames only advance the resume anchor). On any transport
+    error it reconnects and re-subscribes from ``last seen revision``,
+    which the server replays from its bounded event history — exactly
+    once unless compacted, which is surfaced as a compacted batch. A
+    reconnect therefore never silently loses or duplicates events.
+    """
+
+    def __init__(self, client: "StoreClient", prefix: str,
+                 start_revision: int | None, *, heartbeat: float = 2.0,
+                 reconnect_backoff: float = 0.2):
+        self._client = client
+        self.prefix = prefix
+        self._heartbeat = heartbeat
+        self._backoff = reconnect_backoff
+        self._last_rev = start_revision  # None until the first ack
+        self.created_revision = start_revision or 0
+        self._cond = threading.Condition()
+        self._queue: deque[WatchBatch] = deque()
+        self._stop = threading.Event()
+        self._sock: socket.socket | None = None
+        self._ready = threading.Event()   # first ack received
+        self._rejected: str | None = None  # server refused the op
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"store-watch-{prefix}")
+        self._thread.start()
+        # Block until the subscription is established so "events after
+        # watch() returned" is a real guarantee, not a race. A server
+        # that REJECTS the op (no watch support) raises — try_watch
+        # falls back to polling; a merely unreachable server keeps
+        # retrying in the background instead.
+        self._ready.wait(timeout=client._timeout)
+        if self._rejected is not None:
+            self.cancel()
+            raise EdlStoreError(f"watch rejected: {self._rejected}")
+
+    # -- reader thread -------------------------------------------------------
+
+    def _run(self) -> None:
+        first = True
+        while not self._stop.is_set():
+            try:
+                sock = self._client._connect()
+            except EdlStoreError:
+                if self._stop.wait(max(self._backoff, 1.0)):
+                    return
+                continue
+            with self._cond:
+                if self._stop.is_set():
+                    sock.close()
+                    return
+                self._sock = sock
+            try:
+                wire.send_msg(sock, {"op": "watch", "prefix": self.prefix,
+                                     "start_revision": self._last_rev,
+                                     "heartbeat": self._heartbeat})
+                # heartbeats bound the silence: a server that stops
+                # sending for several heartbeat periods is dead
+                sock.settimeout(max(1.0, self._heartbeat * 5))
+                ack = wire.recv_msg(sock)
+                if not (ack.get("ok") and ack.get("watching")):
+                    # an explicit refusal is permanent (op unsupported):
+                    # surface it instead of reconnect-looping forever
+                    self._rejected = str(ack.get("error", ack))
+                    self._ready.set()
+                    return
+                if self._last_rev is None:
+                    self._last_rev = int(ack["revision"])
+                    self.created_revision = self._last_rev
+                self._ready.set()
+                if not first:
+                    log.info("watch %r resumed from revision %d",
+                             self.prefix, self._last_rev)
+                first = False
+                while True:
+                    msg = wire.recv_msg(sock)
+                    events = tuple(Event(*e) for e in msg.get("events", ()))
+                    revision = int(msg["revision"])
+                    compacted = bool(msg.get("compacted"))
+                    self._last_rev = revision
+                    if events or compacted:
+                        self._push(WatchBatch(events, revision, compacted))
+            except (OSError, wire.WireError, KeyError, TypeError,
+                    ValueError) as exc:
+                if not self._stop.is_set():
+                    log.debug("watch %r stream error (%s); reconnecting",
+                              self.prefix, exc)
+            finally:
+                with self._cond:
+                    self._sock = None
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._stop.wait(self._backoff)
+
+    def _push(self, batch: WatchBatch) -> None:
+        with self._cond:
+            self._queue.append(batch)
+            self._cond.notify_all()
+
+    # -- consumer side -------------------------------------------------------
+
+    def get(self, timeout: float | None = None) -> WatchBatch | None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._queue and not self._stop.is_set():
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            if self._queue:
+                return self._queue.popleft()
+            return None
+
+    def progress_revision(self) -> int | None:
+        with self._cond:
+            if self._queue:
+                return None
+            return self._last_rev
+
+    def cancel(self) -> None:
+        self._stop.set()
+        with self._cond:
+            sock = self._sock
+            self._sock = None
+            self._cond.notify_all()
+        if sock is not None:
+            try:
+                sock.close()  # wakes the blocked recv
+            except OSError:
+                pass
+        self._thread.join(timeout=2.0)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._stop.is_set()
 
 
 class LeaseKeeper:

@@ -100,6 +100,10 @@ class UtilizationPublisher:
         self._lease: int | None = None
         self._keeper = None
         self._lock = threading.Lock()
+        # flush() blocks on this instead of spinning: notified whenever
+        # _pending reaches zero (the bench host has ONE core — a 10 ms
+        # sleep-poll loop here measurably stole it from training)
+        self._drained = threading.Condition(self._lock)
         self._last_pub = 0.0
         # rate window seeds on the FIRST call: samples_seen may restore
         # non-zero from a checkpoint, and measuring from 0 would report
@@ -219,6 +223,8 @@ class UtilizationPublisher:
             finally:
                 with self._lock:
                     self._pending -= 1
+                    if self._pending <= 0:
+                        self._drained.notify_all()
 
     def _publish(self, doc: dict) -> None:
         now = time.monotonic()
@@ -236,14 +242,12 @@ class UtilizationPublisher:
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Wait for every enqueued snapshot to be published (or dropped
-        by the cooldown); True when the mailbox drained in time."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            with self._lock:
-                if self._pending <= 0:
-                    return True
-            time.sleep(0.01)
-        return False
+        by the cooldown); True when the mailbox drained in time. Blocks
+        on a condition (no spin: the publisher thread notifies when the
+        last snapshot lands)."""
+        with self._drained:
+            return self._drained.wait_for(lambda: self._pending <= 0,
+                                          timeout=timeout)
 
     def stop(self) -> None:
         self.flush(timeout=2.0)   # best-effort final snapshot

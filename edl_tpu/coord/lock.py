@@ -91,14 +91,42 @@ class DistributedLock:
 
     def acquire(self, timeout: float | None = None,
                 poll: float = 0.2) -> bool:
-        """Block (up to timeout) until acquired."""
+        """Block (up to timeout) until acquired.
+
+        Waiters subscribe to the lock key and wake on the holder's
+        DELETE (release or lease expiry) instead of re-polling every
+        `poll` seconds — handoff latency becomes event latency. The
+        poll is kept as a TTL-derived fallback: with an in-process
+        store and no sweeper thread, a dead holder's lease only expires
+        on a store *call*, so a pure event wait could sleep forever.
+        EDL_TPU_COORD_WATCH=0 restores the original fixed-poll loop.
+        """
+        from edl_tpu.coord.store import try_watch
         deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            if self.try_acquire():
-                return True
-            if deadline is not None and time.monotonic() >= deadline:
-                return False
-            time.sleep(poll)
+        watch = None
+        try:
+            while True:
+                if self.try_acquire():
+                    return True
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    return False
+                if watch is None:
+                    watch = try_watch(self.store, self.key)
+                if watch is not None:
+                    # fallback re-poll at a TTL-derived interval (see
+                    # docstring); the DELETE event wakes us early
+                    wait = max(poll, min(5.0, self.ttl / 2.0))
+                    if deadline is not None:
+                        wait = min(wait, deadline - now)
+                    watch.get(timeout=max(wait, 0.0))
+                else:
+                    wait = poll if deadline is None \
+                        else min(poll, deadline - now)
+                    time.sleep(max(wait, 0.0))
+        finally:
+            if watch is not None:
+                watch.cancel()
 
     # -- hold state ---------------------------------------------------------
 

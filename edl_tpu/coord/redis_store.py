@@ -25,24 +25,41 @@ Mapping:
 - prefix reads use SCAN (cursor loop), not KEYS — the discovery server
   polls every tick and KEYS blocks a production redis on the whole
   keyspace;
+- watches ride pub/sub: every mutation issued THROUGH this class also
+  PUBLISHes a JSON event on ``!edl:events``, and ``watch(prefix)``
+  subscribes on a dedicated connection. Pub/sub is fire-and-forget —
+  no revision history, no replay — so the contract is weaker than the
+  edl store's: a (re)connect and any requested ``start_revision``
+  surface as an explicit ``compacted`` batch (consumer resyncs via
+  ``get_prefix``), and TTL expiry emits NO event (redis expires keys
+  silently) — which is exactly why every event consumer keeps its
+  poll-resync safety net.
 - scope matches the reference's: the redis flavor serves the
   DISCOVERY/DISTILL pillar. `compare_and_swap` is GET-compare-SET —
   correct only for single-writer keys (a Registration reclaiming its
   own key), which is all the discovery stack needs; CONTENDED cas
-  (DistributedLock, task master, rank claims) and event watches stay
-  on the edl store, exactly as the reference kept its master on etcd.
-  Out-of-scope methods raise EdlRedisError — a subclass of
-  EdlStoreError, so the registry's bounded-retry paths treat it as a
-  store failure rather than dying.
+  (DistributedLock, task master, rank claims) and `events_since`
+  history reads stay on the edl store, exactly as the reference kept
+  its master on etcd. Out-of-scope methods raise EdlRedisError — a
+  subclass of EdlStoreError, so the registry's bounded-retry paths
+  treat it as a store failure rather than dying.
 """
 
 from __future__ import annotations
 
 import json
+import socket
+import threading
+import time
+from collections import deque
 
-from edl_tpu.coord.resp import RespClient
-from edl_tpu.coord.store import Record, Store
+from edl_tpu.coord.resp import (RespClient, RespError, encode_command,
+                                read_reply)
+from edl_tpu.coord.store import Event, Record, Store, Watch, WatchBatch
 from edl_tpu.utils.exceptions import EdlStoreError
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.coord.redis_store")
 
 
 class EdlRedisError(EdlStoreError):
@@ -51,6 +68,7 @@ class EdlRedisError(EdlStoreError):
 
 _REV = "!edl:rev"
 _LEASE_ID = "!edl:lease:id"
+_EVENTS_CHANNEL = "!edl:events"
 
 
 def _lease_key(lease: int) -> str:
@@ -71,7 +89,23 @@ class RedisStore(Store):
     """Store subset over RESP (see module docstring for the mapping)."""
 
     def __init__(self, endpoint: str, timeout: float = 10.0):
+        self._endpoint = endpoint
+        self._timeout = timeout
         self._client = RespClient(endpoint, timeout=timeout)
+
+    def _publish_event(self, type_: str, key: str, value: str,
+                       revision: int) -> None:
+        """Best-effort watch feed: a failed PUBLISH only delays watchers
+        until their resync tick — it must never fail the mutation."""
+        if key.startswith("!edl:"):
+            return  # bookkeeping keys are not record data
+        try:
+            self._client.command(
+                "PUBLISH", _EVENTS_CHANNEL,
+                json.dumps({"type": type_, "key": key, "value": value,
+                            "revision": revision}, sort_keys=True))
+        except EdlStoreError as exc:
+            log.debug("event publish failed for %s %s: %s", type_, key, exc)
 
     def close(self) -> None:
         self._client.close()
@@ -129,6 +163,7 @@ class RedisStore(Store):
             members = _lease_key(lease) + ":k"
             self._client.command("SADD", members, key)
             self._client.command("PEXPIRE", members, ttl_ms)
+        self._publish_event("PUT", key, value, rev)
         return True, rev
 
     def put(self, key: str, value: str, lease: int = 0) -> int:
@@ -186,16 +221,29 @@ class RedisStore(Store):
         return recs, rev
 
     def delete(self, key: str) -> bool:
-        self._detach(key, self._client.command("GET", key), new_lease=0)
-        return int(self._client.command("DEL", key)) > 0
+        blob = self._client.command("GET", key)
+        self._detach(key, blob, new_lease=0)
+        deleted = int(self._client.command("DEL", key)) > 0
+        if deleted:
+            rec = self._decode(key, blob)
+            self._publish_event("DELETE", key,
+                                rec.value if rec is not None else "",
+                                self._bump())
+        return deleted
 
     def delete_prefix(self, prefix: str) -> int:
         keys = self._scan(_glob_escape(prefix) + "*")
         if not keys:
             return 0
-        for key, blob in zip(keys, self._client.command("MGET", *keys)):
+        blobs = self._client.command("MGET", *keys)
+        for key, blob in zip(keys, blobs):
             self._detach(key, blob, new_lease=0)
-        return int(self._client.command("DEL", *keys))
+        count = int(self._client.command("DEL", *keys))
+        for key, blob in zip(keys, blobs):
+            rec = self._decode(key, blob)
+            if rec is not None:
+                self._publish_event("DELETE", key, rec.value, self._bump())
+        return count
 
     # -- leases ------------------------------------------------------------
 
@@ -221,12 +269,19 @@ class RedisStore(Store):
         return True
 
     def lease_revoke(self, lease: int) -> bool:
-        members = self._client.command(
-            "SMEMBERS", _lease_key(lease) + ":k") or []
+        members = list(self._client.command(
+            "SMEMBERS", _lease_key(lease) + ":k") or [])
         existed = self._client.command("GET", _lease_key(lease)) is not None
-        targets = list(members) + [_lease_key(lease),
-                                   _lease_key(lease) + ":k"]
+        blobs = self._client.command("MGET", *members) if members else []
+        targets = members + [_lease_key(lease), _lease_key(lease) + ":k"]
         self._client.command("DEL", *targets)
+        # explicit revoke emits DELETE events (InMemStore parity); TTL
+        # EXPIRY still cannot — redis drops keys silently, which is why
+        # watch consumers keep their resync net (module docstring)
+        for key, blob in zip(members, blobs):
+            rec = self._decode(key, blob)
+            if rec is not None:
+                self._publish_event("DELETE", key, rec.value, self._bump())
         return existed
 
     # -- cas: SINGLE-WRITER keys only ---------------------------------------
@@ -253,12 +308,155 @@ class RedisStore(Store):
             return self.put_if_absent(key, value, lease)
         return self._set(key, value, lease, nx=False)[0]
 
+    # -- watches (pub/sub) ---------------------------------------------------
+
+    def watch(self, prefix: str = "", start_revision: int | None = None
+              ) -> "RedisWatch":
+        """Pub/sub watch (module docstring has the weakened contract:
+        no replay, so resume requests and reconnects surface as
+        ``compacted`` batches, and TTL expiry emits no event)."""
+        return RedisWatch(self._endpoint, prefix,
+                          start_revision=start_revision,
+                          timeout=self._timeout)
+
     # -- out of the redis flavor's scope ------------------------------------
 
     def events_since(self, revision: int, prefix: str = ""):
         raise EdlRedisError(
-            "event watches are not served by the redis flavor; watchers "
-            "over redis poll get_prefix (ServiceWatcher already does)")
+            "event history reads are not served by the redis flavor; "
+            "use watch() (pub/sub, no replay) or poll get_prefix")
+
+
+class RedisWatch(Watch):
+    """SUBSCRIBE-fed watch stream over a dedicated RESP connection.
+
+    Messages are ``{"type", "key", "value", "revision"}`` JSON on the
+    ``!edl:events`` channel, filtered by prefix client-side. Because
+    pub/sub has no history, anything that may have dropped messages —
+    an explicit ``start_revision`` (we cannot replay) and every
+    (re)connect after the first — delivers a ``compacted`` batch so the
+    consumer resyncs via ``get_prefix``.
+    """
+
+    expiry_events = False  # TTL expiry is silent in redis
+
+    def __init__(self, endpoint: str, prefix: str, *,
+                 start_revision: int | None = None, timeout: float = 10.0,
+                 reconnect_backoff: float = 0.2):
+        from edl_tpu.utils.net import split_endpoint
+        self._addr = split_endpoint(endpoint)
+        self.prefix = prefix
+        self._timeout = timeout
+        self._backoff = reconnect_backoff
+        self._cond = threading.Condition()
+        self._queue: deque[WatchBatch] = deque()
+        self._stop = threading.Event()
+        self._sock: socket.socket | None = None
+        self._last_rev = 0
+        if start_revision is not None:
+            # no replay over pub/sub: force an immediate resync
+            self._queue.append(WatchBatch((), start_revision, True))
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"redis-watch-{prefix}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        first = True
+        while not self._stop.is_set():
+            rf = None
+            try:
+                sock = socket.create_connection(self._addr,
+                                                timeout=self._timeout)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(None)  # idle channels are legal
+            except OSError:
+                if self._stop.wait(max(self._backoff, 1.0)):
+                    return
+                continue
+            with self._cond:
+                if self._stop.is_set():
+                    sock.close()
+                    return
+                self._sock = sock
+            try:
+                sock.sendall(encode_command(("SUBSCRIBE", _EVENTS_CHANNEL)))
+                rf = sock.makefile("rb")
+                read_reply(rf)  # ["subscribe", channel, 1]
+                if not first:
+                    # the gap had no feed: events may be lost
+                    self._push(WatchBatch((), self._last_rev, True))
+                first = False
+                while True:
+                    msg = read_reply(rf)
+                    if not (isinstance(msg, list) and len(msg) == 3
+                            and msg[0] == "message"):
+                        continue
+                    try:
+                        doc = json.loads(msg[2])
+                        ev = Event(doc["type"], doc["key"], doc["value"],
+                                   int(doc["revision"]))
+                    except (json.JSONDecodeError, KeyError, TypeError,
+                            ValueError):
+                        continue
+                    self._last_rev = max(self._last_rev, ev.revision)
+                    if ev.key.startswith(self.prefix):
+                        self._push(WatchBatch((ev,), ev.revision))
+            except (RespError, OSError):
+                pass
+            finally:
+                with self._cond:
+                    self._sock = None
+                if rf is not None:
+                    try:
+                        rf.close()
+                    except OSError:
+                        pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._stop.wait(self._backoff)
+
+    def _push(self, batch: WatchBatch) -> None:
+        with self._cond:
+            self._queue.append(batch)
+            self._cond.notify_all()
+
+    def get(self, timeout: float | None = None) -> WatchBatch | None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._queue and not self._stop.is_set():
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            if self._queue:
+                return self._queue.popleft()
+            return None
+
+    def progress_revision(self) -> int | None:
+        with self._cond:
+            if self._queue:
+                return None
+            return self._last_rev
+
+    def cancel(self) -> None:
+        self._stop.set()
+        with self._cond:
+            sock = self._sock
+            self._sock = None
+            self._cond.notify_all()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._thread.join(timeout=2.0)
+
+    @property
+    def cancelled(self) -> bool:
+        return self._stop.is_set()
 
 
 def connect_store(endpoint: str, timeout: float = 10.0) -> Store:

@@ -13,14 +13,19 @@ Pieces:
 - ``Registration`` — ephemeral registration: lease + keepalive thread +
   bounded re-register after expiry (reference discovery/register.py:41-77:
   refresh every ttl/6, re-register after expiry, bounded retries).
-- ``ServiceWatcher`` — polls event history and fires deduplicated
-  add/remove callbacks (reference discovery/etcd_client.py:115-149).
+- ``ServiceWatcher`` — fires deduplicated add/remove/update callbacks
+  from the store's watch stream (reference discovery/etcd_client.py:
+  115-149 did this over etcd watches); the original poll loop is
+  demoted to a slow resync safety net, and remains the primary path
+  when watches are unavailable (redis TTL expiry emits no event) or
+  disabled (EDL_TPU_COORD_WATCH=0).
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from dataclasses import dataclass
 
 from edl_tpu.coord.store import Store
@@ -134,26 +139,45 @@ class Registration:
 
 
 class ServiceWatcher:
-    """Poll thread diffing service membership; dedup add/remove callbacks."""
+    """Membership watcher: event-driven callbacks + poll-resync net.
+
+    When the store serves watches, add/remove/update callbacks fire at
+    event latency (PUT/DELETE on the service prefix, including
+    lease-expiry DELETEs) and the full ``get_prefix`` diff only runs
+    every ``resync_interval`` as a safety net (or immediately after a
+    compacted batch or a throwing callback). Without watches
+    (EDL_TPU_COORD_WATCH=0, redis flavor outage) the original
+    ``interval`` poll loop is the whole mechanism.
+    """
 
     def __init__(self, registry: "ServiceRegistry", service: str,
                  on_add=None, on_remove=None, on_update=None,
-                 interval: float = 1.0):
+                 interval: float = 1.0, resync_interval: float | None = None):
         self._registry = registry
         self._service = service
         self._on_add = on_add
         self._on_remove = on_remove
         self._on_update = on_update
         self._interval = interval
+        self._resync_interval = resync_interval
         self._stop = threading.Event()
         self._known: dict[str, ServerMeta] = {}
+        self._watch = None
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"watch-{service}")
 
     def start(self) -> "ServiceWatcher":
+        # Subscribe BEFORE the initial sync: events that land while the
+        # snapshot is read are buffered and deduplicated afterwards
+        # (same info + revision -> no second callback), so there is no
+        # blind window between snapshot and stream.
+        from edl_tpu.coord.store import try_watch
+        self._watch = try_watch(
+            self._registry.store,
+            self._registry.service_prefix(self._service))
         # Initial sync is best-effort: a transient store error here must not
         # leave the caller holding a watcher whose thread never started —
-        # the poll loop will converge on the next tick.
+        # the loop will converge on the next event/tick.
         self._safe_sync()
         self._thread.start()
         return self
@@ -191,15 +215,83 @@ class ServiceWatcher:
             log.warning("watch %s poll failed: %s: %s", self._service,
                         type(exc).__name__, exc)
 
+    def _apply_events(self, events) -> None:
+        """Incremental `_sync`: one event, one callback. `_known` is
+        only updated after the callback returns, so a throwing consumer
+        gets the event redelivered by the resync diff (same contract as
+        the poll path)."""
+        prefix = self._registry.service_prefix(self._service)
+        for ev in events:
+            server = ev.key[len(prefix):]
+            try:
+                if ev.type == "DELETE":
+                    meta = self._known.get(server)
+                    if meta is None:
+                        continue
+                    if self._on_remove:
+                        self._on_remove(meta)
+                    self._known.pop(server, None)
+                    continue
+                try:
+                    doc = json.loads(ev.value)
+                    meta = ServerMeta(doc["server"], doc.get("info", ""),
+                                      ev.revision)
+                except (json.JSONDecodeError, KeyError, TypeError):
+                    # same skip rule as get_service: a malformed value
+                    # must not fabricate membership the resync diff
+                    # would then "remove"
+                    log.warning("malformed registry value at %s", ev.key)
+                    continue
+                old = self._known.get(server)
+                if old is None:
+                    if self._on_add:
+                        self._on_add(meta)
+                    self._known[server] = meta
+                elif old.info != meta.info or old.revision != meta.revision:
+                    if self._on_update:
+                        self._on_update(meta)
+                    self._known[server] = meta
+            except Exception as exc:  # noqa: BLE001 — user callback threw
+                log.warning("watch %s callback failed on %s %s: %s",
+                            self._service, ev.type, ev.key, exc)
+                self._safe_sync()  # redeliver via the snapshot diff
+
     def _run(self) -> None:
-        while not self._stop.wait(self._interval):
-            self._safe_sync()
+        if self._watch is None:
+            while not self._stop.wait(self._interval):
+                self._safe_sync()
+            return
+        from edl_tpu.coord.store import watch_resync_interval
+        if self._resync_interval is not None:
+            resync = self._resync_interval
+        elif not self._watch.expiry_events:
+            # redis pub/sub can't push TTL-expiry DELETEs: dead-server
+            # removal still rides the poll, so keep the poll cadence
+            resync = self._interval
+        else:
+            resync = watch_resync_interval(
+                default=max(self._interval * 10, 30.0))
+        next_resync = time.monotonic() + resync
+        while not self._stop.is_set():
+            batch = self._watch.get(
+                timeout=max(0.0, next_resync - time.monotonic()))
+            if self._stop.is_set():
+                return
+            if batch is None:  # resync safety net tick
+                self._safe_sync()
+                next_resync = time.monotonic() + resync
+            elif batch.compacted:
+                self._safe_sync()
+            else:
+                self._apply_events(batch.events)
 
     def servers(self) -> list[ServerMeta]:
         return sorted(self._known.values(), key=lambda m: m.server)
 
     def stop(self) -> None:
         self._stop.set()
+        if self._watch is not None:
+            self._watch.cancel()
         self._thread.join(timeout=2.0)
 
 
@@ -248,6 +340,7 @@ class ServiceRegistry:
     # -- watch -------------------------------------------------------------
 
     def watch_service(self, service: str, on_add=None, on_remove=None,
-                      on_update=None, interval: float = 1.0) -> ServiceWatcher:
+                      on_update=None, interval: float = 1.0,
+                      resync_interval: float | None = None) -> ServiceWatcher:
         return ServiceWatcher(self, service, on_add, on_remove, on_update,
-                              interval).start()
+                              interval, resync_interval).start()

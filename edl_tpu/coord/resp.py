@@ -10,7 +10,11 @@ the registry needs for tests and single-box runs (no redis binary or
 client library exists in this image; both halves are pure sockets).
 
 Commands MiniRedis serves: PING, SET [NX] [PX ms], GET, MGET, DEL,
-KEYS, SCAN, INCR, SADD, SMEMBERS, PEXPIRE, PTTL, EXISTS, FLUSHALL.
+KEYS, SCAN, INCR, SADD, SMEMBERS, PEXPIRE, PTTL, EXISTS, FLUSHALL,
+plus PUBLISH / SUBSCRIBE (the pub/sub half of the RedisStore watch
+flavor: pushed ["message", channel, payload] arrays, exactly redis's
+RESP2 shape, sent to subscriber connections from the publisher's
+thread under a per-connection send lock).
 Expiry is millisecond-granular (PEXPIRE / SET PX) because registry TTLs
 in tests are sub-second; keys expire lazily on access plus in scans.
 Glob patterns honor redis semantics including backslash escapes (fnmatch
@@ -217,12 +221,52 @@ class RespClient:
 
 # -- minimal server ----------------------------------------------------------
 
+class _Subscriber:
+    """One subscribed connection: socket + send lock (pushed messages
+    come from publisher threads, replies from the handler thread — the
+    lock keeps frames from interleaving mid-write)."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.send_lock = threading.Lock()
+
+    def send(self, value) -> None:
+        with self.send_lock:
+            self.sock.sendall(encode_reply(value))
+
+
 class _State:
     def __init__(self):
         self.lock = threading.RLock()
         self.strings: dict[str, str] = {}
         self.sets: dict[str, set] = {}
         self.deadlines: dict[str, float] = {}  # key -> monotonic deadline
+        self.subscribers: dict[str, set[_Subscriber]] = {}
+
+    # -- pub/sub (socket-touching: called from _Handler, not execute) -------
+
+    def subscribe(self, channel: str, sub: _Subscriber) -> int:
+        with self.lock:
+            self.subscribers.setdefault(channel, set()).add(sub)
+            return sum(1 for subs in self.subscribers.values()
+                       if sub in subs)
+
+    def unsubscribe(self, sub: _Subscriber) -> None:
+        with self.lock:
+            for subs in self.subscribers.values():
+                subs.discard(sub)
+
+    def publish(self, channel: str, message: str) -> int:
+        with self.lock:
+            subs = list(self.subscribers.get(channel, ()))
+        delivered = 0
+        for sub in subs:
+            try:
+                sub.send(["message", channel, message])
+                delivered += 1
+            except OSError:
+                self.unsubscribe(sub)  # dead subscriber: drop it
+        return delivered
 
     def _alive(self, key: str) -> bool:
         dl = self.deadlines.get(key)
@@ -344,6 +388,7 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         state: _State = self.server.state  # type: ignore[attr-defined]
         rf = self.request.makefile("rb")
+        sub: _Subscriber | None = None
         try:
             while True:
                 try:
@@ -352,15 +397,34 @@ class _Handler(socketserver.BaseRequestHandler):
                     return  # disconnect / garbage: drop the connection
                 if not isinstance(cmd, list) or not cmd:
                     return
+                args = [str(c) for c in cmd]
+                name = args[0].upper()
                 try:
-                    reply = state.execute([str(c) for c in cmd])
+                    if name == "SUBSCRIBE":
+                        if sub is None:
+                            sub = _Subscriber(self.request)
+                        for channel in args[1:]:
+                            n = state.subscribe(channel, sub)
+                            sub.send(["subscribe", channel, n])
+                        continue
+                    if name == "PUBLISH":
+                        reply = state.publish(args[1], args[2])
+                    else:
+                        reply = state.execute(args)
+                except OSError:
+                    return
                 except Exception as exc:  # noqa: BLE001 — to the client
                     reply = ("-", f"ERR {type(exc).__name__}: {exc}")
                 try:
-                    self.request.sendall(encode_reply(reply))
+                    if sub is not None:
+                        sub.send(reply)
+                    else:
+                        self.request.sendall(encode_reply(reply))
                 except OSError:
                     return
         finally:
+            if sub is not None:
+                state.unsubscribe(sub)
             rf.close()
 
 

@@ -35,6 +35,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 req = wire.recv_msg(sock)
             except (wire.WireError, OSError):
                 return
+            if req.get("op") == "watch":
+                # long-lived: the connection becomes a push stream and
+                # ends when the client disconnects or the server stops
+                self._serve_watch(store, sock, req, self.server)
+                return
             try:
                 resp = self._dispatch(store, req)
             except Exception as exc:  # surface the error to the client
@@ -43,6 +48,58 @@ class _Handler(socketserver.BaseRequestHandler):
                 wire.send_msg(sock, resp)
             except OSError:
                 return
+
+    @staticmethod
+    def _serve_watch(store: InMemStore, sock: socket.socket,
+                     req: dict, server) -> None:
+        """The server half of the watch stream (wire.py protocol doc):
+        ack, then event frames as they happen, with empty heartbeat
+        frames advancing the client's resume anchor while idle — the
+        heartbeat is also how a dead client is detected (its send
+        fails) so the watcher never leaks."""
+        try:
+            heartbeat = float(req.get("heartbeat") or 2.0)
+            watch = store.watch(req.get("prefix", ""),
+                                start_revision=req.get("start_revision"))
+        except Exception as exc:  # noqa: BLE001 — surface to the client
+            try:
+                wire.send_msg(sock, {"ok": False,
+                                     "error": f"{type(exc).__name__}: {exc}"})
+            except OSError:
+                pass
+            return
+        # registered so StoreServer.stop() can close live streams: a
+        # stopped server whose handler threads kept streaming would look
+        # alive to clients and mask a restart (resume/compaction would
+        # never trigger)
+        with server.watch_lock:
+            server.active_watches.add(watch)
+        try:
+            wire.send_msg(sock, {"ok": True, "watching": True,
+                                 "revision": watch.created_revision})
+            while True:
+                batch = watch.get(timeout=heartbeat)
+                if batch is None:
+                    if watch.cancelled:
+                        return
+                    rev = watch.progress_revision()
+                    if rev is None:
+                        continue  # an event raced in: deliver it next loop
+                    msg = {"ok": True, "events": [], "revision": rev,
+                           "compacted": False}
+                else:
+                    msg = {"ok": True,
+                           "events": [[e.type, e.key, e.value, e.revision]
+                                      for e in batch.events],
+                           "revision": batch.revision,
+                           "compacted": batch.compacted}
+                wire.send_msg(sock, msg)
+        except OSError:
+            return
+        finally:
+            with server.watch_lock:
+                server.active_watches.discard(watch)
+            watch.cancel()
 
     @staticmethod
     def _dispatch(store: InMemStore, req: dict) -> dict:
@@ -99,6 +156,8 @@ class StoreServer:
         self.store = store or InMemStore()
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.store = self.store  # type: ignore[attr-defined]
+        self._server.active_watches = set()  # type: ignore[attr-defined]
+        self._server.watch_lock = threading.Lock()  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -121,6 +180,12 @@ class StoreServer:
 
     def stop(self) -> None:
         self._stop.set()
+        # end live watch streams: their handler threads wake, close the
+        # connections, and clients reconnect (resuming by revision)
+        with self._server.watch_lock:  # type: ignore[attr-defined]
+            watches = list(self._server.active_watches)  # type: ignore[attr-defined]
+        for watch in watches:
+            watch.cancel()
         self._server.shutdown()
         self._server.server_close()
 

@@ -18,14 +18,23 @@ Semantics:
 - **Events**: bounded history of PUT/DELETE, queryable by
   ``events_since(revision, prefix)``; if the window was compacted the caller
   gets ``compacted=True`` and must fall back to a full ``get_prefix``.
+- **Watches**: ``watch(prefix, start_revision)`` subscribes to the same
+  PUT/DELETE stream as a push feed (the reference's etcd v3 watch,
+  discovery/etcd_client.py:115-149) — per-watcher bounded queue, lease-expiry
+  DELETEs included, compaction/overflow signalled as a ``compacted`` batch so
+  the consumer resyncs via ``get_prefix``. ``EDL_TPU_COORD_WATCH=0`` disables
+  watches everywhere (``try_watch`` returns None) and every consumer falls
+  back to its original polling loop.
 - **CAS**: ``put_if_absent`` is the rank-claim primitive
   (reference utils/register.py:60-88).
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 
@@ -51,6 +60,165 @@ class _Lease:
     ttl: float
     deadline: float
     keys: set[str] = field(default_factory=set)
+
+
+@dataclass(frozen=True)
+class WatchBatch:
+    """One watch delivery: events (revision-ordered), the resume anchor
+    `revision` (resume a new watch from here to miss nothing), and the
+    `compacted` flag — True means events were lost (history compaction
+    or watcher-queue overflow) and the consumer MUST resync with a full
+    ``get_prefix`` before trusting incremental state again."""
+    events: tuple[Event, ...]
+    revision: int
+    compacted: bool = False
+
+
+def watch_enabled() -> bool:
+    """The EDL_TPU_COORD_WATCH=0 escape hatch: restores pure polling in
+    every converted consumer (read per call so tests can flip it)."""
+    return os.environ.get("EDL_TPU_COORD_WATCH", "1") != "0"
+
+
+def watch_resync_interval(default: float = 30.0) -> float:
+    """How often event-driven consumers still run their full-poll resync
+    safety net (EDL_TPU_WATCH_RESYNC_S). The net catches what events
+    cannot promise: missed wakeups, redis TTL expiry (no event), and
+    user-callback failures."""
+    raw = os.environ.get("EDL_TPU_WATCH_RESYNC_S", "").strip()
+    if raw:
+        try:
+            return max(0.1, float(raw))
+        except ValueError:
+            pass
+    return default
+
+
+def try_watch(store: "Store", prefix: str = "", start_revision: int | None
+              = None) -> "Watch | None":
+    """A watch on `store`, or None when watches are disabled
+    (EDL_TPU_COORD_WATCH=0), unsupported by this store flavor, or the
+    subscribe itself fails — callers treat None as 'keep polling'."""
+    if not watch_enabled():
+        return None
+    try:
+        return store.watch(prefix, start_revision=start_revision)
+    except Exception:  # noqa: BLE001 — unsupported flavor / transient
+        return None
+
+
+class Watch:
+    """Handle for one watch stream (InMemStore, StoreClient and
+    RedisStore each implement this shape).
+
+    - ``get(timeout)`` -> next WatchBatch, or None on timeout/cancel.
+    - ``progress_revision()`` -> the resume anchor when the queue is
+      drained (None while batches are pending), used for heartbeats.
+    - ``cancel()`` unsubscribes and wakes any blocked ``get``.
+    """
+
+    prefix: str = ""
+    created_revision: int = 0
+    # False when the flavor cannot deliver lease/TTL-expiry DELETEs
+    # (redis pub/sub): consumers then keep their original poll cadence
+    # for the resync net instead of the slow watch-mode cadence.
+    expiry_events: bool = True
+
+    def get(self, timeout: float | None = None) -> WatchBatch | None:
+        raise NotImplementedError
+
+    def progress_revision(self) -> int | None:
+        raise NotImplementedError
+
+    def cancel(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def cancelled(self) -> bool:
+        raise NotImplementedError
+
+    def __enter__(self) -> "Watch":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.cancel()
+
+
+class InMemWatch(Watch):
+    """In-process watch: bounded per-watcher queue fed under the store
+    lock by ``_emit``. Overflow collapses the queue into one compacted
+    batch rather than blocking the store or growing without bound."""
+
+    def __init__(self, store: "InMemStore", prefix: str, max_pending: int):
+        self._store = store
+        self.prefix = prefix
+        self._max = max_pending
+        self._cond = threading.Condition()
+        self._queue: deque[WatchBatch] = deque()
+        self._pending_events = 0
+        self._cancelled = False
+
+    # -- producer side (store lock held) ------------------------------------
+
+    def _push(self, ev: Event) -> None:
+        with self._cond:
+            if self._cancelled:
+                return
+            if self._pending_events >= self._max:
+                # lagging consumer: drop everything, force a resync
+                self._queue.clear()
+                self._pending_events = 0
+                self._queue.append(WatchBatch((), ev.revision, True))
+            else:
+                self._pending_events += 1
+                self._queue.append(WatchBatch((ev,), ev.revision))
+            self._cond.notify_all()
+
+    def _push_compacted(self, revision: int) -> None:
+        with self._cond:
+            if self._cancelled:
+                return
+            self._queue.append(WatchBatch((), revision, True))
+            self._cond.notify_all()
+
+    # -- consumer side -------------------------------------------------------
+
+    def get(self, timeout: float | None = None) -> WatchBatch | None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._queue and not self._cancelled:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+            if self._queue:
+                batch = self._queue.popleft()
+                self._pending_events -= len(batch.events)
+                return batch
+            return None
+
+    def progress_revision(self) -> int | None:
+        # Atomic with _emit (both take the store lock): a None answer
+        # means a batch is pending; a revision answer means every event
+        # <= that revision in this prefix has already been delivered —
+        # safe to advertise as the client's resume anchor.
+        with self._store._lock:
+            with self._cond:
+                if self._queue or self._cancelled:
+                    return None
+                return self._store._revision
+
+    def cancel(self) -> None:
+        self._store._unwatch(self)
+        with self._cond:
+            self._cancelled = True
+            self._cond.notify_all()
+
+    @property
+    def cancelled(self) -> bool:
+        with self._cond:
+            return self._cancelled
 
 
 class Store:
@@ -92,8 +260,18 @@ class Store:
         """Return (events, current_revision, compacted)."""
         raise NotImplementedError
 
+    def watch(self, prefix: str = "", start_revision: int | None = None
+              ) -> "Watch":
+        """Subscribe to PUT/DELETE events under `prefix` as a push
+        stream. ``start_revision`` replays history after that revision
+        first (compacted batch when the window no longer covers it);
+        None starts from now. Flavors without watches raise — use
+        ``try_watch`` to fall back to polling."""
+        raise NotImplementedError
+
 
 _MAX_EVENTS = 4096
+_MAX_WATCH_PENDING = 4096
 
 
 class InMemStore(Store):
@@ -109,6 +287,10 @@ class InMemStore(Store):
         self._events: list[Event] = []
         self._max_events = max_events
         self._first_event_rev = 1  # events older than this were compacted
+        self._watchers: list[InMemWatch] = []
+        # public Store-API calls served (bench: poll- vs watch-mode
+        # request volume); watch deliveries are pushes, not requests
+        self.op_count = 0
 
     # -- internals ---------------------------------------------------------
 
@@ -122,6 +304,9 @@ class InMemStore(Store):
             drop = len(self._events) - self._max_events
             self._first_event_rev = self._events[drop].revision
             del self._events[:drop]
+        for watcher in self._watchers:
+            if ev.key.startswith(watcher.prefix):
+                watcher._push(ev)
 
     def _expire(self) -> None:
         now = self._clock()
@@ -146,6 +331,7 @@ class InMemStore(Store):
 
     def put(self, key: str, value: str, lease: int = 0) -> int:
         with self._lock:
+            self.op_count += 1
             self._expire()
             self._check_lease(lease)
             old = self._data.get(key)
@@ -160,11 +346,13 @@ class InMemStore(Store):
 
     def get(self, key: str) -> Record | None:
         with self._lock:
+            self.op_count += 1
             self._expire()
             return self._data.get(key)
 
     def get_prefix(self, prefix: str) -> tuple[list[Record], int]:
         with self._lock:
+            self.op_count += 1
             self._expire()
             recs = sorted(
                 (r for k, r in self._data.items() if k.startswith(prefix)),
@@ -174,6 +362,7 @@ class InMemStore(Store):
 
     def delete(self, key: str) -> bool:
         with self._lock:
+            self.op_count += 1
             self._expire()
             rec = self._data.pop(key, None)
             if rec is None:
@@ -184,6 +373,7 @@ class InMemStore(Store):
 
     def delete_prefix(self, prefix: str) -> int:
         with self._lock:
+            self.op_count += 1
             self._expire()
             keys = [k for k in self._data if k.startswith(prefix)]
             for k in keys:
@@ -194,6 +384,7 @@ class InMemStore(Store):
 
     def put_if_absent(self, key: str, value: str, lease: int = 0) -> bool:
         with self._lock:
+            self.op_count += 1
             self._expire()
             if key in self._data:
                 return False
@@ -208,6 +399,7 @@ class InMemStore(Store):
     def compare_and_swap(self, key: str, expect: str | None, value: str,
                          lease: int = 0) -> bool:
         with self._lock:
+            self.op_count += 1
             self._expire()
             cur = self._data.get(key)
             if expect is None:
@@ -220,6 +412,7 @@ class InMemStore(Store):
 
     def lease_grant(self, ttl: float) -> int:
         with self._lock:
+            self.op_count += 1
             self._expire()
             lease_id = self._next_lease
             self._next_lease += 1
@@ -228,6 +421,7 @@ class InMemStore(Store):
 
     def lease_keepalive(self, lease: int) -> bool:
         with self._lock:
+            self.op_count += 1
             self._expire()
             entry = self._leases.get(lease)
             if entry is None:
@@ -237,6 +431,7 @@ class InMemStore(Store):
 
     def lease_revoke(self, lease: int) -> bool:
         with self._lock:
+            self.op_count += 1
             self._expire()
             entry = self._leases.pop(lease, None)
             if entry is None:
@@ -250,6 +445,7 @@ class InMemStore(Store):
     def events_since(self, revision: int, prefix: str = ""
                      ) -> tuple[list[Event], int, bool]:
         with self._lock:
+            self.op_count += 1
             self._expire()
             if revision + 1 < self._first_event_rev:
                 return [], self._revision, True
@@ -257,7 +453,45 @@ class InMemStore(Store):
                    if e.revision > revision and e.key.startswith(prefix)]
             return evs, self._revision, False
 
+    @property
+    def current_revision(self) -> int:
+        with self._lock:
+            return self._revision
+
     def sweep(self) -> None:
-        """Expire due leases now (called by the server's sweeper thread)."""
+        """Expire due leases now (called by the server's sweeper thread).
+        Not counted as a request: it is the server's own maintenance, and
+        it is what turns lease expiry into DELETE events for watchers
+        even when no client traffic arrives."""
         with self._lock:
             self._expire()
+
+    # -- watches -------------------------------------------------------------
+
+    def watch(self, prefix: str = "", start_revision: int | None = None,
+              max_pending: int = _MAX_WATCH_PENDING) -> InMemWatch:
+        with self._lock:
+            self._expire()
+            watcher = InMemWatch(self, prefix, max_pending)
+            watcher.created_revision = self._revision
+            if start_revision is not None:
+                if start_revision + 1 < self._first_event_rev:
+                    watcher._push_compacted(self._revision)
+                else:
+                    for ev in self._events:
+                        if ev.revision > start_revision \
+                                and ev.key.startswith(prefix):
+                            watcher._push(ev)
+            self._watchers.append(watcher)
+            return watcher
+
+    def _unwatch(self, watcher: InMemWatch) -> None:
+        with self._lock:
+            try:
+                self._watchers.remove(watcher)
+            except ValueError:
+                pass  # already cancelled
+
+    def watcher_count(self) -> int:
+        with self._lock:
+            return len(self._watchers)

@@ -6,6 +6,25 @@ body. Requests are ``{"op": str, ...args}``; responses are
 ``edl-store`` daemon (native/store/) speaks the same frames, so the Python
 client works against either server.
 
+Every op is one request -> one response, except ``watch``, which turns the
+connection into a long-lived server-push stream:
+
+    client -> {"op": "watch", "prefix": str,
+               "start_revision": int | null, "heartbeat": float}
+    server -> {"ok": true, "watching": true, "revision": int}   # ack; the
+              # revision is the watch's creation anchor (resume point when
+              # start_revision was null)
+    server -> {"ok": true, "events": [[type, key, value, revision], ...],
+               "revision": int, "compacted": bool}              # repeated
+
+Event frames are pushed as mutations happen; an empty ``events`` frame is a
+heartbeat (sent every ``heartbeat`` seconds when idle) whose ``revision``
+advances the client's resume anchor and doubles as liveness. A frame with
+``compacted: true`` means events were lost (history compaction or a lagging
+watcher queue): the client must resync with ``get_prefix`` and may resume
+from that frame's revision. There is no cancel op — the client closes the
+connection. The full resume/compaction contract is doc/design_coord.md.
+
 (The reference's redis balancer path uses an analogous hand-rolled framed
 protocol: distill/redis/balance_server.py:27-32. Ours differs in magic,
 framing and message schema by design.)

@@ -42,7 +42,13 @@ log = get_logger("edl_tpu.scaler.controller")
 
 @dataclass
 class ScalerConfig:
+    # the decision-pass FALLBACK period: with store watches the
+    # controller ticks when fresh utilization actually arrives (floor =
+    # min_tick_s), so reaction latency is event latency, not interval/2
     interval: float = field(5.0, env="EDL_TPU_SCALER_INTERVAL")
+    # event-driven tick floor: a busy fleet publishing utilization every
+    # second must not turn the scaler into a hot loop
+    min_tick_s: float = field(1.0, env="EDL_TPU_SCALER_MIN_TICK")
     cooldown_s: float = field(30.0, env="EDL_TPU_SCALER_COOLDOWN")
     gain_threshold: float = field(0.05, env="EDL_TPU_SCALER_GAIN")
     # the resize price the policy amortizes every grow against — the
@@ -229,6 +235,10 @@ class ScalerController:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._restored = False
+        # event-driven pacing: fresh utilization under /{job}/util/
+        # kicks the next tick instead of waiting out the interval
+        self._kick = threading.Event()
+        self._util_watches: list = []
 
     # -- observation --------------------------------------------------------
 
@@ -384,19 +394,70 @@ class ScalerController:
                                if prop.predicted_gain is not None
                                else None)})
 
-    def run(self) -> None:
-        """Campaign, then tick every interval while leader (blocking)."""
+    # -- event-driven pacing -------------------------------------------------
+
+    def _start_util_watches(self) -> None:
+        """Subscribe to each job's utilization prefix: a fresh record
+        kicks the next decision pass so reaction latency is event
+        latency (floored at min_tick_s), with `interval` demoted to the
+        no-traffic fallback. Unavailable/disabled watches leave the
+        original fixed-interval loop untouched."""
+        from edl_tpu.coord.collector import util_prefix
+        from edl_tpu.coord.store import try_watch
+        for job in self.jobs:
+            watch = try_watch(self.store, util_prefix(job))
+            if watch is None:
+                continue
+            thread = threading.Thread(target=self._pump_kicks, args=(watch,),
+                                      daemon=True,
+                                      name=f"edl-scaler-watch-{job}")
+            thread.start()
+            self._util_watches.append((watch, thread))
+        if self._util_watches:
+            log.info("scaler ticking on utilization events (%d watches; "
+                     "fallback every %.1fs)", len(self._util_watches),
+                     self.config.interval)
+
+    def _pump_kicks(self, watch) -> None:
         while not self._stop.is_set():
-            if self.election is not None and not self.election.is_leader():
-                if not self.election.campaign(timeout=1.0):
-                    continue
-                log.info("scaler leadership acquired (%s)", self.owner)
-                self._restored = False  # re-replay on every takeover
-            try:
-                self.tick()
-            except Exception:  # noqa: BLE001 — scrape failures are
-                log.exception("scaler tick failed")  # transient: keep going
-            self._stop.wait(self.config.interval)
+            batch = watch.get(timeout=5.0)
+            if batch is None:
+                if watch.cancelled:
+                    return
+                continue
+            if batch.events or batch.compacted:
+                self._kick.set()
+
+    def _stop_util_watches(self) -> None:
+        for watch, _ in self._util_watches:
+            watch.cancel()
+        for _, thread in self._util_watches:
+            thread.join(timeout=2.0)
+        self._util_watches = []
+
+    def run(self) -> None:
+        """Campaign, then tick on fresh utilization (or every interval
+        as the fallback) while leader (blocking)."""
+        self._start_util_watches()
+        try:
+            while not self._stop.is_set():
+                if self.election is not None \
+                        and not self.election.is_leader():
+                    if not self.election.campaign(timeout=1.0):
+                        continue
+                    log.info("scaler leadership acquired (%s)", self.owner)
+                    self._restored = False  # re-replay on every takeover
+                self._kick.clear()
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — scrape failures are
+                    log.exception("scaler tick failed")  # transient
+                # kicks that landed during the tick are still set here
+                if self._kick.wait(timeout=self.config.interval) \
+                        and not self._stop.is_set():
+                    self._stop.wait(self.config.min_tick_s)
+        finally:
+            self._stop_util_watches()
 
     def start(self) -> "ScalerController":
         self._thread = threading.Thread(target=self.run, daemon=True,
@@ -406,6 +467,7 @@ class ScalerController:
 
     def stop(self) -> None:
         self._stop.set()
+        self._kick.set()  # wake the fallback wait immediately
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None

@@ -150,11 +150,70 @@ static Json dispatch(Store& store, const Json& req) {
   return err("unknown op '" + op + "'");
 }
 
+// The long-lived half of the protocol (edl_tpu/coord/wire.py): ack with
+// the creation revision, then push event frames as mutations land, with
+// empty heartbeat frames while idle. The heartbeat's failed send is how
+// a dead client is detected, so a watcher never outlives its peer by
+// more than ~2 heartbeat periods.
+static void serve_watch(Store* store, int fd, const Json& req) {
+  std::string prefix;
+  if (req.has("prefix") && !req["prefix"].is_null())
+    prefix = req["prefix"].as_string();
+  int64_t start = -1;
+  if (req.has("start_revision") && !req["start_revision"].is_null())
+    start = req["start_revision"].as_int();
+  double heartbeat = 2.0;
+  if (req.has("heartbeat") && !req["heartbeat"].is_null()) {
+    heartbeat = req["heartbeat"].as_double();
+    if (heartbeat <= 0) heartbeat = 2.0;
+  }
+  auto w = store->watch(prefix, start);
+  if (!send_msg(fd, ok({{"watching", Json(true)},
+                        {"revision", Json(w->created_revision)}}))) {
+    store->watch_cancel(w);
+    return;
+  }
+  while (true) {
+    auto batch = w->wait_batch(heartbeat);
+    Json msg;
+    if (batch) {
+      JsonArray arr;
+      for (const auto& ev : batch->events)
+        arr.push_back(Json(JsonArray{Json(ev.type), Json(ev.key),
+                                     Json(ev.value), Json(ev.revision)}));
+      msg = ok({{"events", Json(std::move(arr))},
+                {"revision", Json(batch->revision)},
+                {"compacted", Json(batch->compacted)}});
+    } else {
+      if (w->cancelled()) break;
+      auto rev = store->watch_progress(w);
+      if (!rev) continue;  // an event raced in: deliver it next loop
+      msg = ok({{"events", Json(JsonArray{})},
+                {"revision", Json(*rev)},
+                {"compacted", Json(false)}});
+    }
+    if (!send_msg(fd, msg)) break;
+  }
+  store->watch_cancel(w);
+}
+
 static void serve_connection(Store* store, int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   Json req;
   while (recv_msg(fd, &req)) {
+    bool is_watch = false;
+    try {
+      is_watch = req.has("op") && req["op"].as_string() == "watch";
+    } catch (const std::exception&) {
+      is_watch = false;
+    }
+    if (is_watch) {
+      // the connection becomes a push stream; it ends when the client
+      // disconnects (there is no cancel op)
+      serve_watch(store, fd, req);
+      break;
+    }
     Json resp;
     try {
       resp = dispatch(*store, req);

@@ -3,6 +3,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cerrno>
 #include <cstring>
 #include <fstream>
@@ -36,9 +37,111 @@ Store::~Store() {
   if (wal_) std::fclose(wal_);
 }
 
+// ---- watchers -------------------------------------------------------------
+
+void Watcher::push(const Event& ev) {
+  if (ev.key.compare(0, prefix_.size(), prefix_) != 0) return;
+  std::lock_guard<std::mutex> lk(*wmu_);
+  if (cancelled_) return;
+  if (pending_.size() >= max_pending_) {
+    // lagging consumer: drop everything, force a resync
+    pending_.clear();
+    compacted_ = true;
+    compacted_rev_ = ev.revision;
+  } else {
+    pending_.push_back(ev);
+  }
+  cv_.notify_all();
+}
+
+std::optional<WatchBatch> Watcher::wait_batch(double timeout_s) {
+  std::unique_lock<std::mutex> lk(*wmu_);
+  // wait_until on system_clock, NOT wait_for: libstdc++'s wait_for
+  // takes the pthread_cond_clockwait path, which older libtsan does
+  // not intercept — the wait's internal mutex release then becomes
+  // invisible and every later lock reports as a phantom "double lock".
+  // A clock jump at worst stretches one heartbeat; correctness only
+  // depends on the predicate.
+  auto deadline = std::chrono::system_clock::now() +
+                  std::chrono::duration_cast<std::chrono::milliseconds>(
+                      std::chrono::duration<double>(timeout_s));
+  cv_.wait_until(lk, deadline, [&] {
+    return cancelled_ || compacted_ || !pending_.empty();
+  });
+  if (compacted_) {
+    // the compacted signal outranks anything queued after the overflow
+    compacted_ = false;
+    pending_.clear();
+    WatchBatch batch;
+    batch.compacted = true;
+    batch.revision = compacted_rev_;
+    return batch;
+  }
+  if (!pending_.empty()) {
+    WatchBatch batch;
+    batch.events.assign(pending_.begin(), pending_.end());
+    pending_.clear();
+    batch.revision = batch.events.back().revision;
+    return batch;
+  }
+  return std::nullopt;  // timeout or cancelled
+}
+
+bool Watcher::cancelled() {
+  std::lock_guard<std::mutex> lk(*wmu_);
+  return cancelled_;
+}
+
+std::shared_ptr<Watcher> Store::watch(const std::string& prefix,
+                                      int64_t start_revision) {
+  std::lock_guard<std::mutex> lock(mu_);
+  expire();
+  auto w = std::make_shared<Watcher>();
+  {
+    std::lock_guard<std::mutex> lk(watch_mu_);
+    w->wmu_ = &watch_mu_;
+    w->prefix_ = prefix;
+    w->created_revision = revision_;
+    if (start_revision >= 0) {
+      if (start_revision + 1 < first_event_rev_) {
+        w->compacted_ = true;
+        w->compacted_rev_ = revision_;
+      } else {
+        for (const auto& ev : events_)
+          if (ev.revision > start_revision &&
+              ev.key.compare(0, prefix.size(), prefix) == 0)
+            w->pending_.push_back(ev);
+      }
+    }
+  }
+  watchers_.push_back(w);
+  return w;
+}
+
+void Store::watch_cancel(const std::shared_ptr<Watcher>& w) {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    watchers_.erase(std::remove(watchers_.begin(), watchers_.end(), w),
+                    watchers_.end());
+  }
+  std::lock_guard<std::mutex> lk(watch_mu_);
+  w->cancelled_ = true;
+  w->cv_.notify_all();
+}
+
+std::optional<int64_t> Store::watch_progress(
+    const std::shared_ptr<Watcher>& w) {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::lock_guard<std::mutex> lk(watch_mu_);
+  if (!w->pending_.empty() || w->compacted_ || w->cancelled_)
+    return std::nullopt;
+  return revision_;
+}
+
 // ---- unlocked internals ---------------------------------------------------
 
 void Store::emit(Event ev) {
+  for (auto& w : watchers_) w->push(ev);
   events_.push_back(std::move(ev));
   if (events_.size() > max_events_) {
     size_t drop = events_.size() - max_events_;

@@ -11,8 +11,11 @@
 #pragma once
 
 #include <chrono>
+#include <condition_variable>
 #include <cstdio>
+#include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <optional>
 #include <set>
@@ -46,6 +49,48 @@ struct LeaseExpiredError : std::runtime_error {
                            " unknown or expired") {}
 };
 
+// One watch delivery (mirrors Python WatchBatch): events in revision
+// order, the resume anchor, and the compacted flag (history compaction
+// or queue overflow -> the consumer must resync via get_prefix).
+struct WatchBatch {
+  std::vector<Event> events;
+  int64_t revision = 0;
+  bool compacted = false;
+};
+
+// One subscriber's stream state. The Store fans events out on its
+// mutation path (Store::emit, store mutex held) into this queue; queue
+// state is guarded by the STORE-owned watch mutex (shared across
+// watchers on purpose: a per-watcher std::mutex is never
+// pthread_mutex_destroy'd by libstdc++, and watcher churn then recycles
+// heap addresses with stale TSAN lock state — the shared mutex lives as
+// long as the store, so the tsan build stays clean; contention is
+// negligible at control-plane rates). Each watcher keeps its own
+// condition variable. Lock order: store.mu_ -> watch_mu_, never the
+// reverse.
+class Watcher {
+ public:
+  // Blocks up to timeout for the next batch; nullopt on timeout or
+  // cancellation. A non-empty pending queue drains as ONE batch.
+  std::optional<WatchBatch> wait_batch(double timeout_s);
+  bool cancelled();
+
+  int64_t created_revision = 0;  // resume anchor for from-now watches
+
+ private:
+  friend class Store;
+  void push(const Event& ev);  // caller holds the STORE mutex
+
+  std::mutex* wmu_ = nullptr;  // Store::watch_mu_ (outlives the watcher)
+  std::string prefix_;
+  size_t max_pending_ = 4096;
+  std::condition_variable cv_;
+  std::deque<Event> pending_;
+  bool compacted_ = false;
+  int64_t compacted_rev_ = 0;
+  bool cancelled_ = false;
+};
+
 class Store {
  public:
   using Clock = std::chrono::steady_clock;
@@ -75,6 +120,16 @@ class Store {
   std::tuple<std::vector<Event>, int64_t, bool> events_since(
       int64_t revision, const std::string& prefix);
   void sweep();
+
+  // Subscribe to PUT/DELETE events under prefix. start_revision < 0
+  // means "from now"; otherwise history after that revision is queued
+  // first (a compacted batch when the window no longer covers it).
+  std::shared_ptr<Watcher> watch(const std::string& prefix,
+                                 int64_t start_revision);
+  void watch_cancel(const std::shared_ptr<Watcher>& w);
+  // Heartbeat anchor: the current revision iff the watcher's queue is
+  // drained (atomic with emit — both hold mu_), else nullopt.
+  std::optional<int64_t> watch_progress(const std::shared_ptr<Watcher>& w);
 
  private:
   struct Lease {
@@ -111,6 +166,8 @@ class Store {
   std::vector<Event> events_;
   size_t max_events_;
   int64_t first_event_rev_ = 1;
+  std::vector<std::shared_ptr<Watcher>> watchers_;
+  std::mutex watch_mu_;  // guards every watcher's queue state
 
   std::string data_dir_;
   bool fsync_ = true;

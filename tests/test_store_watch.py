@@ -1,0 +1,551 @@
+"""The watch/subscribe primitive (ISSUE 8): event ordering, revision
+resume across reconnects, lease-expiry DELETE delivery, the compaction ->
+`compacted` -> get_prefix resync contract, cancel/teardown hygiene — run
+as one parity suite against InMemStore, the Python StoreServer, and
+(skip-if-unbuilt) the native C++ edl-store — plus the converted
+consumers: ServiceWatcher callbacks at event latency, lock/election
+handoff waking on the holder's DELETE, the scaler ticking on fresh
+utilization, the redis pub/sub flavor, and the EDL_TPU_COORD_WATCH=0
+escape hatch pinning the pure-polling fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.server import StoreServer
+from edl_tpu.coord.store import InMemStore, try_watch
+from edl_tpu.utils import net
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native", "store")
+
+
+# -- parity fixtures ---------------------------------------------------------
+
+@pytest.fixture(scope="session")
+def native_binary():
+    build = subprocess.run(["make", "-C", NATIVE_DIR], capture_output=True,
+                           text=True)
+    if build.returncode != 0:
+        pytest.skip(f"native build unavailable:\n{build.stderr[-500:]}")
+    return os.path.join(NATIVE_DIR, "edl-store")
+
+
+def _start_native(binary, tmp_path):
+    port = net.free_port()
+    proc = subprocess.Popen(
+        [binary, "--host", "127.0.0.1", "--port", str(port),
+         "--sweep-interval", "0.05"],
+        stdout=open(tmp_path / "native-watch.log", "ab"),
+        stderr=subprocess.STDOUT)
+    client = StoreClient(f"127.0.0.1:{port}", timeout=5.0)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if client.ping():
+            return proc, client
+        time.sleep(0.1)
+    proc.kill()
+    pytest.fail("edl-store never came up")
+
+
+@pytest.fixture(params=["inmem", "server", "native"])
+def watch_store(request, tmp_path):
+    """The same Store API over all three engines; the suite asserting
+    identical watch semantics against each IS the parity contract."""
+    if request.param == "inmem":
+        yield InMemStore()
+    elif request.param == "server":
+        with StoreServer(port=0, host="127.0.0.1",
+                         sweep_interval=0.05) as srv:
+            client = StoreClient(f"127.0.0.1:{srv.port}")
+            client._test_server = srv  # for leak introspection
+            yield client
+            client.close()
+    else:
+        binary = request.getfixturevalue("native_binary")
+        proc, client = _start_native(binary, tmp_path)
+        yield client
+        client.close()
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def _drain(watch, n_events, timeout=5.0):
+    """Collect exactly n events (flattening batches); fail on timeout."""
+    events, deadline = [], time.monotonic() + timeout
+    while len(events) < n_events and time.monotonic() < deadline:
+        batch = watch.get(timeout=max(0.0, deadline - time.monotonic()))
+        if batch is None:
+            break
+        assert not batch.compacted, f"unexpected compaction: {batch}"
+        events.extend(batch.events)
+    assert len(events) == n_events, f"got {len(events)}/{n_events}: {events}"
+    return events
+
+
+# -- the primitive, across all three engines ---------------------------------
+
+def test_events_ordered_and_prefix_filtered(watch_store):
+    s = watch_store
+    watch = s.watch("/a/")
+    try:
+        s.put("/a/x", "1")
+        s.put("/b/noise", "n")      # outside the prefix: never delivered
+        s.put("/a/y", "2")
+        s.delete("/a/x")
+        events = _drain(watch, 3)
+        assert [(e.type, e.key, e.value) for e in events] == [
+            ("PUT", "/a/x", "1"), ("PUT", "/a/y", "2"),
+            ("DELETE", "/a/x", "1")]
+        revs = [e.revision for e in events]
+        assert revs == sorted(revs) and len(set(revs)) == 3
+        assert watch.get(timeout=0.1) is None
+    finally:
+        watch.cancel()
+
+
+def test_resume_from_revision_exactly_once(watch_store):
+    s = watch_store
+    r0 = s.put("/r/seen", "old")
+    s.put("/r/a", "1")
+    s.put("/r/b", "2")
+    watch = s.watch("/r/", start_revision=r0)
+    try:
+        events = _drain(watch, 2)
+        assert [e.key for e in events] == ["/r/a", "/r/b"]
+        # live events continue after the replayed backlog, no dupes
+        s.put("/r/c", "3")
+        assert _drain(watch, 1)[0].key == "/r/c"
+        assert watch.get(timeout=0.1) is None
+    finally:
+        watch.cancel()
+
+
+def test_lease_expiry_delivers_delete(watch_store):
+    s = watch_store
+    watch = s.watch("/lease/")
+    try:
+        lease = s.lease_grant(0.25)
+        s.put("/lease/k", "v", lease=lease)
+        assert _drain(watch, 1)[0].type == "PUT"
+        # expiry: server flavors sweep on a thread; the in-mem flavor
+        # expires on any public call (the documented lazy contract)
+        deadline = time.monotonic() + 5.0
+        batch = None
+        while batch is None and time.monotonic() < deadline:
+            s.get("/lease/other")  # nudges lazy expiry on in-mem
+            batch = watch.get(timeout=0.2)
+        assert batch is not None, "lease-expiry DELETE never delivered"
+        assert batch.events[0].type == "DELETE"
+        assert batch.events[0].key == "/lease/k"
+    finally:
+        watch.cancel()
+
+
+def test_compaction_forces_explicit_resync(watch_store):
+    s = watch_store
+    r0 = s.put("/c/0", "v")
+    # overflow the bounded event history (4096) past r0
+    for i in range(4200):
+        s.put(f"/c/{i % 37}", str(i))
+    watch = s.watch("/c/", start_revision=r0)
+    try:
+        batch = watch.get(timeout=5.0)
+        assert batch is not None and batch.compacted, batch
+        assert batch.events == () or list(batch.events) == []
+        # the documented recovery: full get_prefix, then the stream is
+        # live again from the compacted batch's revision
+        records, rev = s.get_prefix("/c/")
+        assert records and rev >= batch.revision
+        s.put("/c/after", "resynced")
+        got = _drain(watch, 1)
+        assert got[0].key == "/c/after"
+    finally:
+        watch.cancel()
+
+
+def test_cancel_leaks_nothing(watch_store):
+    s = watch_store
+    watch = s.watch("/x/")
+    s.put("/x/1", "v")
+    assert _drain(watch, 1)
+    watch.cancel()
+    assert watch.get(timeout=0.1) is None
+    assert watch.cancelled
+    # engine-side teardown: in-mem unregisters synchronously; the
+    # servers notice the dead stream within ~2 heartbeats
+    if isinstance(s, InMemStore):
+        assert s.watcher_count() == 0
+    elif hasattr(s, "_test_server"):
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline \
+                and s._test_server.store.watcher_count():
+            time.sleep(0.1)
+        assert s._test_server.store.watcher_count() == 0
+    # the store stays fully usable either way
+    assert s.put("/x/2", "v") > 0
+
+
+def test_lock_handoff_wakes_on_delete(watch_store):
+    """Satellite: StoreLock waiters + election campaigns wake on the
+    holder's DELETE. poll=5s would make a poll-driven handoff take
+    seconds — the asserted latency proves the event path."""
+    from edl_tpu.coord.lock import DistributedLock
+    a = DistributedLock(watch_store, "/locks/m", "A", ttl=5)
+    b = DistributedLock(watch_store, "/locks/m", "B", ttl=5)
+    assert a.try_acquire()
+    handoff = {}
+
+    def waiter():
+        t0 = time.monotonic()
+        handoff["ok"] = b.acquire(timeout=10, poll=5.0)
+        handoff["latency"] = time.monotonic() - t0
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.5)  # let B park on the watch
+    a.release()
+    t.join(timeout=10)
+    assert handoff.get("ok") is True
+    # event wakeup: far under the 5s poll (generous bound for CI)
+    assert handoff["latency"] < 3.0, handoff
+    b.release()
+
+
+# -- reconnect / restart (TCP path) ------------------------------------------
+
+def test_reconnect_resumes_without_loss_or_dup():
+    """Kill the server mid-watch, mutate while it is down, restart on
+    the same port + store: the client watch must deliver the missed
+    events exactly once (resume-from-revision over the wire)."""
+    store = InMemStore()
+    srv = StoreServer(port=0, host="127.0.0.1", store=store,
+                      sweep_interval=0.05).start()
+    port = srv.port
+    client = StoreClient(f"127.0.0.1:{port}")
+    watch = client.watch("/j/", heartbeat=0.2)
+    try:
+        client.put("/j/before", "1")
+        assert _drain(watch, 1)[0].key == "/j/before"
+        srv.stop()
+        store.put("/j/while-down-1", "2")   # engine survives the server
+        store.put("/j/while-down-2", "3")
+        srv2 = StoreServer(port=port, host="127.0.0.1", store=store,
+                           sweep_interval=0.05).start()
+        try:
+            events = _drain(watch, 2, timeout=15.0)
+            assert [e.key for e in events] == ["/j/while-down-1",
+                                               "/j/while-down-2"]
+            store.put("/j/after", "4")
+            assert _drain(watch, 1, timeout=10.0)[0].key == "/j/after"
+            assert watch.get(timeout=0.2) is None  # no duplicates
+        finally:
+            srv2.stop()
+    finally:
+        watch.cancel()
+        client.close()
+
+
+def test_server_restart_compaction_resyncs():
+    """The native store documents that event history does not survive a
+    restart; the Python analogue is a FRESH engine behind the same
+    port. The resumed watch must then see `compacted`, never silently
+    missing events."""
+    srv = StoreServer(port=0, host="127.0.0.1", sweep_interval=0.05).start()
+    port = srv.port
+    client = StoreClient(f"127.0.0.1:{port}")
+    watch = client.watch("/k/", heartbeat=0.2)
+    try:
+        client.put("/k/a", "1")
+        assert _drain(watch, 1)
+        srv.stop()
+        # a fresh engine whose event window starts past the client's
+        # resume revision (the native daemon does exactly this on
+        # restart: history does not survive, first_event_rev = rev + 1)
+        fresh = InMemStore(max_events=2)
+        for i in range(6):  # revisions the old stream never saw
+            fresh.put(f"/k/unseen{i}", "x")
+        srv2 = StoreServer(port=port, host="127.0.0.1", store=fresh,
+                           sweep_interval=0.05).start()
+        try:
+            deadline = time.monotonic() + 15.0
+            batch = None
+            while time.monotonic() < deadline:
+                batch = watch.get(timeout=1.0)
+                if batch is not None:
+                    break
+            # resume revision > fresh store's history start -> the
+            # server cannot prove continuity -> explicit compaction
+            assert batch is not None and batch.compacted, batch
+        finally:
+            srv2.stop()
+    finally:
+        watch.cancel()
+        client.close()
+
+
+# -- converted consumers -----------------------------------------------------
+
+def test_service_watcher_fires_on_events_not_polls():
+    """ServiceWatcher with a 30s poll interval: with watches the
+    callbacks must land at event latency — a poll could not explain
+    sub-second delivery."""
+    from edl_tpu.coord.registry import ServiceRegistry
+    store = InMemStore()
+    registry = ServiceRegistry(store, root="t")
+    added, removed = [], []
+    add_ev, rm_ev = threading.Event(), threading.Event()
+    watcher = registry.watch_service(
+        "svc",
+        on_add=lambda m: (added.append(m.server), add_ev.set()),
+        on_remove=lambda m: (removed.append(m.server), rm_ev.set()),
+        interval=30.0)
+    try:
+        registry.register_permanent("svc", "a:1", info="x")
+        assert add_ev.wait(2.0), "on_add waited for a poll tick"
+        assert added == ["a:1"]
+        registry.deregister("svc", "a:1")
+        assert rm_ev.wait(2.0), "on_remove waited for a poll tick"
+        assert removed == ["a:1"]
+        assert watcher.servers() == []
+    finally:
+        watcher.stop()
+
+
+def test_cluster_watcher_sees_change_at_event_latency():
+    from edl_tpu.collective import register as reg
+    from edl_tpu.collective.cluster import Cluster, Pod
+    from edl_tpu.collective.watcher import ClusterWatcher
+    store = InMemStore()
+    pods = []
+    for i in range(2):
+        pod = Pod(pod_id=f"p{i}", addr="127.0.0.1", port=7000 + i)
+        r = reg.PodRegister(store, "wjob", pod, ttl=10.0)
+        r.claim()
+        pods.append((pod, r))
+    baseline = Cluster(job_id="wjob", version=1,
+                       pods=[p for p, _ in pods])
+    watcher = ClusterWatcher(store, baseline, interval=30.0).start()
+    try:
+        time.sleep(0.3)
+        assert not watcher.changed.is_set()
+        t0 = time.monotonic()
+        pods[1][1].release()  # departure -> DELETE on the rank prefix
+        assert watcher.changed.wait(3.0), \
+            "membership change waited for a poll tick"
+        assert time.monotonic() - t0 < 3.0
+    finally:
+        watcher.stop()
+        for _, r in pods:
+            r.release()
+
+
+def test_scaler_ticks_on_fresh_utilization_not_interval():
+    """The scaler's reaction is no longer quantized to the interval: a
+    fresh utilization PUT triggers a decision pass while the 30s
+    fallback interval is still far away."""
+    from edl_tpu.coord.collector import util_key
+    from edl_tpu.scaler.controller import ScalerConfig, ScalerController
+    from edl_tpu.scaler.policy import Proposal
+
+    class HoldPolicy:
+        def decide(self, views, now):
+            return [Proposal(v.job_id, v.world_size, v.world_size, "hold")
+                    for v in views]
+
+        def restore(self, entries):
+            pass
+
+        def notify_resized(self, job_id, world, now):
+            pass
+
+    store = InMemStore()
+    config = ScalerConfig()
+    config.interval = 30.0
+    config.min_tick_s = 0.0
+    ctl = ScalerController(store, ["wjob"], HoldPolicy(), config=config,
+                           dry_run=True, elect=False)
+    ctl.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while not ctl.journal.tail() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        n0 = len(ctl.journal.tail())
+        assert n0 >= 1, "first tick never ran"
+        time.sleep(1.0)  # idle: no fresh util -> no extra ticks
+        assert len(ctl.journal.tail()) == n0
+        t0 = time.monotonic()
+        store.put(util_key("wjob", "pod0"), json.dumps(
+            {"examples_per_sec": 10.0, "published_unix": time.time(),
+             "world_size": 1}))
+        while len(ctl.journal.tail()) == n0 \
+                and time.monotonic() - t0 < 10.0:
+            time.sleep(0.05)
+        reaction = time.monotonic() - t0
+        assert len(ctl.journal.tail()) > n0, \
+            "fresh utilization never triggered a tick"
+        assert reaction < 10.0 < config.interval, reaction
+    finally:
+        ctl.stop()
+
+
+def test_redis_pubsub_watch_flavor():
+    from edl_tpu.coord.redis_store import RedisStore
+    from edl_tpu.coord.resp import MiniRedis
+    mini = MiniRedis().start()
+    store = RedisStore(mini.endpoint)
+    try:
+        watch = store.watch("/svc/")
+        time.sleep(0.2)  # let SUBSCRIBE land
+        store.put("/svc/a", "v1")
+        batch = watch.get(timeout=3.0)
+        assert batch.events[0].type == "PUT"
+        assert batch.events[0].key == "/svc/a"
+        store.put("/other/x", "n")
+        assert watch.get(timeout=0.3) is None  # prefix-filtered
+        store.delete("/svc/a")
+        batch = watch.get(timeout=3.0)
+        assert batch.events[0].type == "DELETE"
+        assert batch.events[0].value == "v1"
+        # explicit revoke emits DELETEs (TTL expiry cannot — the
+        # documented weaker contract; expiry_events=False keeps the
+        # consumers' poll cadence as the net)
+        lease = store.lease_grant(5.0)
+        store.put("/svc/leased", "x", lease=lease)
+        assert watch.get(timeout=3.0).events[0].type == "PUT"
+        store.lease_revoke(lease)
+        assert watch.get(timeout=3.0).events[0].type == "DELETE"
+        assert not watch.expiry_events
+        # no replay over pub/sub: a resume request is an immediate,
+        # explicit resync signal
+        resumed = store.watch("/svc/", start_revision=1)
+        assert resumed.get(timeout=2.0).compacted
+        resumed.cancel()
+        watch.cancel()
+    finally:
+        store.close()
+        mini.stop()
+
+
+def test_escape_hatch_restores_pure_polling(monkeypatch):
+    """EDL_TPU_COORD_WATCH=0 (satellite): try_watch refuses, no watcher
+    registers anywhere, and the converted consumers still work on their
+    original poll loops — the integration pin for the escape hatch."""
+    from edl_tpu.coord.lock import DistributedLock
+    from edl_tpu.coord.registry import ServiceRegistry
+    monkeypatch.setenv("EDL_TPU_COORD_WATCH", "0")
+    store = InMemStore()
+    assert try_watch(store, "/any/") is None
+    # ServiceWatcher: poll-driven callbacks still fire
+    registry = ServiceRegistry(store, root="t")
+    seen = threading.Event()
+    watcher = registry.watch_service("svc", on_add=lambda m: seen.set(),
+                                     interval=0.05)
+    registry.register_permanent("svc", "a:1")
+    assert seen.wait(2.0)
+    assert store.watcher_count() == 0, "a watch leaked past the hatch"
+    watcher.stop()
+    # lock handoff still completes on the poll fallback
+    a = DistributedLock(store, "/l", "A", ttl=5)
+    b = DistributedLock(store, "/l", "B", ttl=5)
+    assert a.try_acquire()
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(b.acquire(timeout=5, poll=0.05)))
+    t.start()
+    time.sleep(0.2)
+    a.release()
+    t.join(timeout=10)
+    assert got == [True]
+    assert store.watcher_count() == 0
+    b.release()
+
+
+# -- native tsan selftest (CI sequential step) -------------------------------
+
+@pytest.fixture(scope="session")
+def tsan_binary():
+    build = subprocess.run(["make", "-C", NATIVE_DIR, "tsan"],
+                           capture_output=True, text=True)
+    if build.returncode != 0:
+        pytest.skip(f"tsan build unavailable:\n{build.stderr[-500:]}")
+    return os.path.join(NATIVE_DIR, "edl-store-tsan")
+
+
+@pytest.mark.slow
+def test_native_watch_selftest_tsan(tsan_binary, tmp_path):
+    """Concurrent watchers churning against concurrent mutators + the
+    sweeper, under ThreadSanitizer: the watcher registry and fan-out
+    ride the store's mutation path, so any locking mistake in the new
+    code is a data race this run aborts on (halt_on_error)."""
+    port = net.free_port()
+    log_path = tmp_path / "tsan-watch.log"
+    env = dict(os.environ,
+               TSAN_OPTIONS="halt_on_error=1 exitcode=66 abort_on_error=0")
+    proc = subprocess.Popen(
+        [tsan_binary, "--host", "127.0.0.1", "--port", str(port),
+         "--sweep-interval", "0.01"],
+        stdout=open(log_path, "ab"), stderr=subprocess.STDOUT, env=env)
+    boot = StoreClient(f"127.0.0.1:{port}", timeout=10.0)
+    deadline = time.time() + 20
+    while time.time() < deadline and not boot.ping():
+        time.sleep(0.1)
+    assert boot.ping(), "tsan daemon never came up"
+    boot.close()
+
+    errors, stop = [], threading.Event()
+
+    def mutator(wid: int):
+        try:
+            c = StoreClient(f"127.0.0.1:{port}", timeout=10.0)
+            for i in range(50):
+                c.put(f"/w/{wid}/{i % 5}", str(i))
+                if i % 4 == 0:
+                    lease = c.lease_grant(0.05)  # sweeper-raced DELETEs
+                    try:
+                        c.put(f"/w/lease/{wid}", "x", lease=lease)
+                    except Exception:  # noqa: BLE001 — the race is the point
+                        pass
+                if i % 7 == 0:
+                    c.delete_prefix(f"/w/{wid}/")
+            c.close()
+        except Exception as exc:  # noqa: BLE001 — collected for assert
+            errors.append(("mut", wid, exc))
+
+    def watcher(wid: int):
+        try:
+            c = StoreClient(f"127.0.0.1:{port}", timeout=10.0)
+            for _ in range(6):  # churn: subscribe, consume, cancel
+                w = c.watch("/w/", heartbeat=0.05)
+                until = time.monotonic() + 0.3
+                while time.monotonic() < until:
+                    w.get(timeout=0.1)
+                w.cancel()
+            c.close()
+        except Exception as exc:  # noqa: BLE001 — collected for assert
+            errors.append(("watch", wid, exc))
+
+    threads = [threading.Thread(target=mutator, args=(w,)) for w in range(4)]
+    threads += [threading.Thread(target=watcher, args=(w,)) for w in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    stop.set()
+    try:
+        assert not errors, f"client errors (daemon died mid-run?): {errors}"
+        assert proc.poll() is None, \
+            f"daemon exited {proc.returncode} — TSAN report:\n" \
+            f"{log_path.read_bytes().decode(errors='replace')[-3000:]}"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+    report = log_path.read_bytes().decode(errors="replace")
+    assert "WARNING: ThreadSanitizer" not in report, report[-3000:]
