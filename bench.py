@@ -1246,6 +1246,56 @@ def bench_scaler(on_tpu: bool) -> dict:
         "scaler_per_curve": per_curve}
 
 
+def bench_serving_slo(on_tpu: bool) -> dict:
+    """Serving-elasticity decision quality on the deterministic
+    SimServingPool (edl_tpu/scaler/serving): how fast the ServingPolicy
+    restores the latency SLO after a load step, what it pays getting
+    there, and whether steady load stays resize-free.
+
+    Three canonical open-loop traces at the default SLO contract:
+    steady (no-thrash baseline), a 4x step (reaction ticks = last SLO
+    violation after the step), and a bounded burst (grow in, DRAIN back
+    out). Deterministic (seeded sim, virtual clock), so regressions
+    here are policy regressions."""
+    from edl_tpu.scaler.serving import ServingConfig, ServingPolicy
+    from edl_tpu.scaler.simulator import (SimServingPool, burst,
+                                          run_serving_policy, steady, step)
+    del on_tpu  # host-side decision plane: identical on every platform
+
+    def policy():
+        return ServingPolicy(ServingConfig(
+            slo_p95_ms=250.0, breach_ticks=2, idle_ticks=5,
+            cooldown_s=15.0, max_teachers=16))
+
+    step_at = 40
+    cases = (("steady", steady(200.0), None),
+             ("step4x", step(100.0, 4.0, at=step_at), step_at),
+             ("burst4x", burst(100.0, 4.0, at=40, length=25), 40))
+    per_trace = {}
+    for name, trace, at in cases:
+        pool = SimServingPool("svc", trace, teachers=1, max_teachers=16,
+                              tick_s=1.0, noise=0.01, seed=0)
+        out = run_serving_policy(pool, policy(), ticks=200,
+                                 settle_ticks=50)
+        per_trace[name] = {
+            "slo_attainment_pct": round(100.0 * out["slo_attainment"], 2),
+            "reaction_ticks": (max(0, out["last_violation_tick"] - at)
+                               if at is not None else 0),
+            "resizes": out["resizes"],
+            "post_convergence_resizes": out["post_convergence_resizes"],
+            "final_teachers": out["final_teachers"]}
+    return {
+        "serving_slo_reaction_ticks":
+            per_trace["step4x"]["reaction_ticks"],
+        "serving_slo_attainment_pct": min(
+            t["slo_attainment_pct"] for t in per_trace.values()),
+        "serving_resizes_paid": sum(
+            t["resizes"] for t in per_trace.values()),
+        "serving_post_convergence_resizes": sum(
+            t["post_convergence_resizes"] for t in per_trace.values()),
+        "serving_per_trace": per_trace}
+
+
 def bench_control_plane(on_tpu: bool) -> dict:
     """Event-driven control plane (ISSUE 8): watch streams vs polling.
 
@@ -1435,6 +1485,7 @@ def main() -> None:
             downtime["elastic_downtime_s"]
             / p2p["elastic_downtime_p2p_s"], 1)
     scaler = bench_scaler(on_tpu)
+    serving_slo = bench_serving_slo(on_tpu)
     control_plane = bench_control_plane(on_tpu)
     cores_to_feed_jpeg = (resnet["imgs_per_sec"]
                           / max(loader["imgs_per_sec_per_core"], 1e-9))
@@ -1568,6 +1619,10 @@ def main() -> None:
             # ticks-to-converge / vs-oracle gap / downtime paid across
             # concave+flat+knee curves (edl_tpu/scaler)
             **scaler,
+            # serving-elasticity plane on the SimServingPool traces:
+            # ticks to restore the latency SLO after a 4x load step,
+            # worst-trace attainment %, resizes paid (scaler/serving)
+            **serving_slo,
             # event-driven control plane: PUT -> watcher-callback
             # latency over TCP, idle store request volume poll- vs
             # watch-mode (same consumer set), and the scaler's

@@ -25,9 +25,11 @@ Two halves:
 - `Collector` — scheduler-side. Snapshots a job (live rank claims,
   published cluster generation, per-pod utilization) + any service
   registries (teacher ``busy_s``/``served_rows``/... from
-  TeacherRegistrar stats) + store health (revision, key/leased-key
-  counts), emitted as one JSON object; the CLI prints one line per tick
-  for a scheduler to consume:
+  TeacherRegistrar stats, plus per-service pool rollups —
+  ``service_rollup`` sums rates/queues, means utilization, and takes
+  the worst teacher's latency tail: the serving scaler's view) + store
+  health (revision, key/leased-key counts), emitted as one JSON
+  object; the CLI prints one line per tick for a scheduler to consume:
 
       python -m edl_tpu.coord.collector --store h:p --job jid \
           --services svc --interval 5
@@ -319,6 +321,47 @@ class Collector:
         return [{"server": m.server, "info": _parse_info(m.info)}
                 for m in registry.get_service(service)]
 
+    def service_rollup(self, service: str) -> dict:
+        """Pool-level digest of one service registry — what the serving
+        scaler consumes. Rates and queue depths SUM across teachers
+        (pool capacity / pool backlog); ``util`` is the mean busy
+        fraction (the low-water shrink signal); latency quantiles take
+        the WORST reporting teacher — the pool's p95 is its slowest
+        member's tail, and a conservative read can only over-provision,
+        never silently violate the SLO. ``reporting`` counts teachers
+        whose registrar published a parseable info doc: ``n_teachers``
+        without ``reporting`` means a pool that is up but blind."""
+        rows, depth, inflight = 0.0, 0, 0
+        utils: list[float] = []
+        p50s: list[float] = []
+        p95s: list[float] = []
+        members = self._service_snapshot(service)
+        reporting = 0
+        for m in members:
+            info = m["info"]
+            if not isinstance(info, dict) or not info:
+                continue  # no/unparseable/empty info: a blind member
+            reporting += 1
+            rows += float(info.get("rows_per_sec") or 0.0)
+            depth += int(info.get("queue_depth") or 0)
+            inflight += int(info.get("inflight_groups") or 0)
+            if info.get("util") is not None:
+                utils.append(float(info["util"]))
+            if info.get("latency_ms_p50") is not None:
+                p50s.append(float(info["latency_ms_p50"]))
+            if info.get("latency_ms_p95") is not None:
+                p95s.append(float(info["latency_ms_p95"]))
+        return {"service": service,
+                "n_teachers": len(members),
+                "reporting": reporting,
+                "rows_per_sec": round(rows, 2),
+                "util": (round(sum(utils) / len(utils), 4)
+                         if utils else None),
+                "queue_depth": depth,
+                "inflight_groups": inflight,
+                "latency_ms_p50": max(p50s) if p50s else None,
+                "latency_ms_p95": max(p95s) if p95s else None}
+
     def snapshot(self) -> dict:
         records, revision = self.store.get_prefix("")
         doc: dict = {"ts": time.time(),
@@ -331,6 +374,8 @@ class Collector:
         if self.services:
             doc["services"] = {s: self._service_snapshot(s)
                                for s in self.services}
+            doc["service_rollups"] = {s: self.service_rollup(s)
+                                      for s in self.services}
         return doc
 
 

@@ -23,10 +23,13 @@ Invariants (property-tested in tests/test_balance.py):
       instead of waiting for client churn);
   I5. versions bump iff the client's server set changed;
   I6. utilization is a TIE-BREAK only: among servers with equal link
-      counts the least-busy (registrar-reported ``util``) is preferred,
-      so the idle S mod C servers of an under-subscribed service are the
-      busiest ones — I1-I4 are unaffected by construction (the link
-      count stays the primary key).
+      counts the least-busy is preferred — the busy score blends the
+      registrar-reported ``util`` with ``queue_depth`` (each queued
+      request adds ``QUEUE_WEIGHT``), so a backlogged teacher sheds new
+      clients before it violates the latency SLO and the idle S mod C
+      servers of an under-subscribed service are the busiest ones —
+      I1-I4 are unaffected by construction (the link count stays the
+      primary key).
 
 Unlike the reference this is a standalone, lock-free-by-construction value
 type: the discovery server owns one instance per service and serializes
@@ -58,6 +61,12 @@ def caps(n_clients: int, n_servers: int) -> tuple[int, int]:
 class ServiceBalance:
     """Assignment state for one service name."""
 
+    # Each queued request adds this much to the busy score: a teacher
+    # with 5+ requests backed up loses every tie even against one
+    # running flat-out with an empty queue — backlog is the leading
+    # indicator of an SLO violation, utilization only the trailing one.
+    QUEUE_WEIGHT = 0.2
+
     def __init__(self, name: str):
         self.name = name
         self.servers: tuple[str, ...] = ()
@@ -67,15 +76,25 @@ class ServiceBalance:
         # when the population leaves servers idle (S mod C) or several
         # candidates tie, the LEAST-busy teachers get the links
         self.utilization: dict[str, float] = {}
+        # reported intake backlog (registrar stats `queue_depth`),
+        # blended into the same tie-break: a backlogged teacher sheds
+        # NEW clients before it violates the latency SLO
+        self.queue_depth: dict[str, int] = {}
 
-    def set_utilization(self, util: dict[str, float]) -> None:
+    def set_utilization(self, util: dict[str, float],
+                        queue_depth: dict[str, int] | None = None) -> None:
         self.utilization = dict(util)
+        if queue_depth is not None:
+            self.queue_depth = dict(queue_depth)
 
     def _busy(self, server: str) -> float:
         # Unknown load is NEUTRAL (0.5), not idle: a non-reporting
         # teacher must not systematically win ties against one honestly
         # reporting a small util — it could be saturated for all we know.
-        return self.utilization.get(server, 0.5)
+        # Queue depth rides on top (unknown = 0: absence of a backlog
+        # report must not outweigh a reported idle queue).
+        return (self.utilization.get(server, 0.5)
+                + self.QUEUE_WEIGHT * self.queue_depth.get(server, 0))
 
     # -- membership --------------------------------------------------------
 

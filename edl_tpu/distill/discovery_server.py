@@ -93,7 +93,7 @@ class BalanceTable:
         util-blind initial fill would freeze name-order links past
         every later tick."""
         svc.set_servers([m.server for m in metas])
-        svc.set_utilization(self._busy_scores(metas))
+        svc.set_utilization(*self._busy_scores(metas))
         svc.rebalance()
 
     def register(self, client_id: str, service: str) -> dict:
@@ -140,16 +140,29 @@ class BalanceTable:
     # -- tick ---------------------------------------------------------------
 
     @staticmethod
-    def _busy_scores(metas) -> dict[str, float]:
-        """Registrar-published busy fractions (`util` in the info JSON)
-        — the balancer's tie-break (balance.py invariant I6)."""
-        scores = {}
+    def _busy_scores(metas) -> tuple[dict[str, float], dict[str, int]]:
+        """Registrar-published busy fractions (`util`) and intake
+        backlogs (`queue_depth`) from the info JSON — the balancer's
+        blended tie-break (balance.py invariant I6). Either field may be
+        missing independently (old-format registrars)."""
+        scores: dict[str, float] = {}
+        depths: dict[str, int] = {}
         for m in metas:
             try:
-                scores[m.server] = float(json.loads(m.info)["util"])
-            except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+                doc = json.loads(m.info)
+            except (json.JSONDecodeError, TypeError):
                 continue  # no/old-format info: neutral score
-        return scores
+            if not isinstance(doc, dict):
+                continue
+            try:
+                scores[m.server] = float(doc["util"])
+            except (KeyError, TypeError, ValueError):
+                pass
+            try:
+                depths[m.server] = int(doc["queue_depth"])
+            except (KeyError, TypeError, ValueError):
+                pass
+        return scores, depths
 
     def tick(self) -> None:
         """Refresh teacher membership, expire silent clients, rebalance."""

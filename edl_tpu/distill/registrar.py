@@ -95,6 +95,7 @@ class TeacherRegistrar:
 
     def _utilization_info(self, cur: dict, prev: dict | None,
                           dt: float) -> str:
+        from edl_tpu.distill.teacher_server import latency_quantile
         d_rows = cur["served_rows"] - (prev or {}).get("served_rows", 0)
         d_busy = cur["busy_s"] - (prev or {}).get("busy_s", 0.0)
         # coalescing effectiveness over THIS window (mean device-batch
@@ -102,12 +103,23 @@ class TeacherRegistrar:
         # would hide a teacher degrading to degenerate 1-request batches
         d_groups = (sum(cur.get("batch_rows_hist", {}).values())
                     - sum((prev or {}).get("batch_rows_hist", {}).values()))
+        # latency over THIS window: difference the cumulative fixed-bucket
+        # histograms (exact — the buckets line up by construction), so a
+        # teacher going slow shows up within one stats interval instead
+        # of being averaged away by its fast past. The SLO signal the
+        # serving scaler consumes; null when the window served nothing.
+        prev_lat = (prev or {}).get("latency_hist_ms", {})
+        d_lat = {k: int(v) - int(prev_lat.get(k, 0))
+                 for k, v in cur.get("latency_hist_ms", {}).items()}
         return json.dumps({
             "rows_per_sec": round(d_rows / max(dt, 1e-9), 1),
             "util": round(min(1.0, d_busy / max(dt, 1e-9)), 3),
             "queue_depth": cur.get("queue_depth", 0),
+            "inflight_groups": cur.get("inflight_groups", 0),
             "batch_rows_mean": round(d_rows / d_groups, 2) if d_groups
             else 0.0,
+            "latency_ms_p50": latency_quantile(d_lat, 0.5),
+            "latency_ms_p95": latency_quantile(d_lat, 0.95),
         }, sort_keys=True)
 
     def _stats_loop(self) -> None:

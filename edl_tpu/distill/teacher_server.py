@@ -55,6 +55,32 @@ log = get_logger("edl_tpu.distill.teacher_server")
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
+# Fixed-bucket per-request latency histogram edges (ms, upper bounds;
+# final bucket is open-ended). Fixed buckets — not a reservoir — so the
+# registrar can difference two cumulative snapshots into an exact
+# windowed histogram and quantiles never drift under load.
+LATENCY_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+def latency_quantile(hist_ms: dict, q: float) -> float | None:
+    """q-quantile of a ``{bucket_upper_ms: count}`` histogram (keys may
+    be str off the wire). Answers with the bucket's UPPER edge —
+    conservative: the reported p95 is never below the true one, so an
+    SLO decision made on it never under-provisions. None when empty."""
+    items = sorted(((float(k), int(v)) for k, v in hist_ms.items()),
+                   key=lambda kv: kv[0])
+    total = sum(c for _, c in items)
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0
+    for edge, count in items:
+        cum += count
+        if cum >= target:
+            return edge
+    return items[-1][0]
+
 
 def pad_to_bucket(n: int, buckets: tuple[int, ...]) -> int:
     for b in buckets:
@@ -70,6 +96,10 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     result: dict[str, np.ndarray] | None = None
     error: str | None = None
+    # submit time: the latency histogram measures submit -> results
+    # ready (coalesce wait + device compute + host fetch) — what a
+    # pipelined client experiences per request, the serving SLO signal
+    t_submit: float = field(default_factory=time.monotonic)
 
 
 class Batcher:
@@ -148,6 +178,9 @@ class Batcher:
         # efficiency question for a serving pool; the histogram makes it
         # observable instead of inferred.
         self._batch_hist: dict[int, int] = {}
+        # Per-request latency histogram (fixed buckets, cumulative):
+        # the SLO signal the serving scaler consumes. inf = overflow.
+        self._lat_hist: dict[float, int] = {}
 
     def start(self) -> "Batcher":
         for t in self._threads:
@@ -286,6 +319,11 @@ class Batcher:
                 self._served_rows += rows
                 self._served_requests += len(group)
                 self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
+                for req in group:
+                    ms = (now - req.t_submit) * 1e3
+                    edge = next((b for b in LATENCY_BUCKETS_MS
+                                 if ms <= b), float("inf"))
+                    self._lat_hist[edge] = self._lat_hist.get(edge, 0) + 1
                 self._groups_inflight -= 1
             offset = 0
             for req in group:
@@ -301,17 +339,25 @@ class Batcher:
             groups = sum(hist.values())
             rows_mean = (sum(r * c for r, c in hist.items()) / groups
                          if groups else 0.0)
+            lat = dict(sorted(self._lat_hist.items()))
             return {"served_rows": self._served_rows,
                     "served_requests": self._served_requests,
                     "busy_s": round(self._busy_s, 4),
                     "uptime_s": round(time.monotonic() - self._started_at, 4),
                     "queue_depth": self._q.qsize(),
+                    # groups past intake (queued/computing/fetching): with
+                    # queue_depth == 0 this is the whole "work still in
+                    # flight" signal a draining pool waits out
+                    "inflight_groups": self._groups_inflight,
                     "pending_hwm": self._pending_hwm,
                     "coalesce_window_ms": round(self._window_ema_s * 1e3,
                                                 3),
                     # JSON object keys are strings on the wire
                     "batch_rows_hist": {str(r): c for r, c in hist.items()},
-                    "batch_rows_mean": round(rows_mean, 2)}
+                    "batch_rows_mean": round(rows_mean, 2),
+                    "latency_hist_ms": {str(b): c for b, c in lat.items()},
+                    "latency_ms_p50": latency_quantile(lat, 0.5),
+                    "latency_ms_p95": latency_quantile(lat, 0.95)}
 
     def stop(self) -> None:
         self._stop.set()

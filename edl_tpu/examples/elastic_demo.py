@@ -18,6 +18,14 @@ journaled. The closed loop the reference's scheduler pillar describes,
 runnable on a laptop:
 
   python -m edl_tpu.examples.elastic_demo --scaler --nodes-range 1:2
+
+`--serve-scaler` runs the OTHER elasticity loop — the serving plane: a
+teacher pool behind the discovery registry, an open-loop load
+generator, and a `ServingPolicy` holding a latency SLO by growing the
+pool on sustained breach and DRAINING it on sustained idleness
+(`run_serve_scaler_demo`):
+
+  python -m edl_tpu.examples.elastic_demo --serve-scaler
 """
 
 from __future__ import annotations
@@ -191,6 +199,191 @@ def run_scaler_demo(args) -> int:
     else:
         shutil.rmtree(os.path.join(tmp, "ckpt"), ignore_errors=True)
     return 0 if complete and not escaped and not silent else 1
+
+
+def run_serve_scaler_demo(args) -> int:
+    """Serving elasticity end-to-end on this host: an in-process store,
+    a `TeacherPoolActuator` spawning real `TeacherServer`s (sleepy
+    predict_fn standing in for chip time) with registrars publishing
+    latency/queue stats, an open-loop load generator, and a
+    `ScalerController` running a `ServingPolicy` — the closed loop from
+    student traffic to pool size. Three load phases: cruise (SLO met),
+    a 4x step (sustained p95 breach -> grow), then near-idle
+    (utilization under the low-water mark -> DRAINED shrink).
+
+    Self-audits on exit and returns non-zero unless:
+
+      - at least one grow AND one shrink were journaled and applied,
+      - every actuated pool resize has a matching journal entry,
+      - at least one shrink completed as a graceful DRAIN (deregister
+        -> in-flight work done -> stop), with zero hard kills,
+      - the pool's latency SLO was met again by the end of the run.
+
+    Prints a machine-readable ``serve_summary=`` line (bench.py-style).
+    """
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    from edl_tpu.coord.registry import ServiceRegistry
+    from edl_tpu.coord.server import StoreServer
+    from edl_tpu.distill.registrar import DISTILL_ROOT, TeacherRegistrar
+    from edl_tpu.distill.teacher_server import TeacherClient, TeacherServer
+    from edl_tpu.scaler.controller import ScalerConfig, ScalerController
+    from edl_tpu.scaler.policy import ThroughputPolicy
+    from edl_tpu.scaler.serving import (LocalTeacher, ServingConfig,
+                                        ServingPolicy, TeacherPoolActuator)
+
+    service = "serve_demo_teacher"
+    tmp = tempfile.mkdtemp(prefix="edl-serve-scaler-")
+    journal_path = args.journal or os.path.join(tmp, "serving.jsonl")
+    srv = StoreServer(port=0, host="127.0.0.1", sweep_interval=0.2).start()
+    per_row_s = 0.002      # the fake chip: 2 ms per row
+    request_rows = 8
+
+    def spawn(index: int) -> LocalTeacher:
+        def predict(feeds):
+            rows = next(iter(feeds.values())).shape[0]
+            time.sleep(rows * per_row_s)
+            return {"logits": np.zeros((rows, 4), np.float32)}
+        server = TeacherServer(predict, port=0, host="127.0.0.1",
+                               max_batch=32, max_wait=0.001).start()
+        registrar = TeacherRegistrar(
+            srv.store, service, f"127.0.0.1:{server.port}",
+            ttl=2.0, stats_interval=0.25, probe_timeout=10.0)
+        registrar.start()
+        return LocalTeacher(server, registrar)
+
+    serve_cfg = ServingConfig(
+        slo_p95_ms=200.0, queue_high=4.0, util_low=0.25,
+        breach_ticks=2, idle_ticks=3, cooldown_s=2.0,
+        min_teachers=1, max_teachers=3, drain_deadline_s=15.0)
+    actuator = TeacherPoolActuator(
+        spawn, min_teachers=serve_cfg.min_teachers,
+        max_teachers=serve_cfg.max_teachers,
+        drain_deadline_s=serve_cfg.drain_deadline_s, service=service)
+    controller = ScalerController(
+        srv.store, [], ThroughputPolicy(),
+        config=ScalerConfig(interval=0.5, min_tick_s=0.2,
+                            staleness_s=5.0),
+        services=[service], serving_policy=ServingPolicy(serve_cfg),
+        serving_actuate=actuator.actuate, serving_config=serve_cfg,
+        journal_path=journal_path, owner="serve-scaler-demo",
+        scope="serve_demo")
+
+    # open-loop-ish load generator: requests/sec follows the phase plan;
+    # endpoints are re-read from the registry so a drained teacher stops
+    # receiving traffic the moment it deregisters
+    phase = {"rate": 20.0}
+    stop = threading.Event()
+
+    def load_loop() -> None:
+        registry = ServiceRegistry(srv.store, root=DISTILL_ROOT)
+        clients: dict[str, TeacherClient] = {}
+        endpoints: list[str] = []
+        rr, last_refresh = 0, 0.0
+        feed = {"image": np.zeros((request_rows, 4), np.float32)}
+        while not stop.is_set():
+            now = time.monotonic()
+            if now - last_refresh > 0.3 or not endpoints:
+                endpoints = [m.server for m in
+                             registry.get_service(service)]
+                for ep in list(clients):
+                    if ep not in endpoints:
+                        clients.pop(ep).close()
+                last_refresh = now
+            if not endpoints:
+                time.sleep(0.05)
+                continue
+            ep = endpoints[rr % len(endpoints)]
+            rr += 1
+            try:
+                client = clients.get(ep)
+                if client is None:
+                    client = TeacherClient(ep, timeout=30.0,
+                                           max_inflight=64)
+                    clients[ep] = client
+                client.predict_async(feed)
+            except Exception:  # noqa: BLE001 — teacher went away
+                clients.pop(ep, None)
+            time.sleep(1.0 / max(phase["rate"], 1e-6))
+        for client in clients.values():
+            client.close()
+
+    load_thread = threading.Thread(target=load_loop, daemon=True,
+                                   name="serve-demo-load")
+    final_ok = False
+    try:
+        actuator.resize(1)   # the initial pool, before any decisions
+        controller.start()
+        load_thread.start()
+        # phase 1 — cruise: ~160 rows/s against 500 rows/s capacity
+        time.sleep(args.serve_phase_s)
+        # phase 2 — 4x step: ~640 rows/s > one teacher's capacity; the
+        # backlog drives p95 over the SLO and the pool must grow
+        phase["rate"] = 80.0
+        time.sleep(2.5 * args.serve_phase_s)
+        # phase 3 — near-idle: the pool must DRAIN back down
+        phase["rate"] = 4.0
+        time.sleep(3.0 * args.serve_phase_s)
+        # final check: SLO met at the end (use the live rollup)
+        roll = controller._service_collector.service_rollup(service)
+        final_ok = (roll["latency_ms_p95"] is None
+                    or roll["latency_ms_p95"] <= serve_cfg.slo_p95_ms)
+    finally:
+        stop.set()
+        load_thread.join(timeout=10)
+        controller.stop()
+        actuator.wait_drains(timeout=serve_cfg.drain_deadline_s + 5)
+        actuator.close()
+        srv.stop()
+
+    entries = []
+    try:
+        with open(journal_path, encoding="utf-8") as f:
+            entries = [json.loads(line) for line in f if line.strip()]
+    except OSError:
+        pass
+    serving = [e for e in entries if e.get("kind") == "serving"]
+    resizes = [e for e in serving if e["action"] == "resize"]
+    grows = [e for e in resizes if e["desired"] > e["current"]]
+    shrinks = [e for e in resizes if e["desired"] < e["current"]]
+    # every actuated resize must be journaled: the actuator's log minus
+    # the initial pre-controller resize(1) is exactly the journal's
+    journaled = [e["applied"] for e in resizes]
+    actuated = [r["to"] for r in actuator.resize_log[1:]]
+    drained = [d for d in actuator.drain_log if d["drained"]]
+    hard_killed = [d for d in actuator.drain_log if d["hard_killed"]]
+    ok = (len(grows) >= 1 and len(shrinks) >= 1
+          and journaled == actuated
+          and len(drained) >= 1 and not hard_killed
+          and final_ok)
+    summary = {"ok": ok, "decisions": len(serving),
+               "grows": len(grows), "shrinks": len(shrinks),
+               "resizes": [{"tick": e["seq"], "from": e["current"],
+                            "to": e["desired"], "reason": e["reason"]}
+                           for e in resizes],
+               "journal_matches_actuated": journaled == actuated,
+               "drained": len(drained),
+               "hard_killed": len(hard_killed),
+               "drain_log": actuator.drain_log,
+               "final_slo_met": final_ok,
+               "journal": journal_path if args.journal else None}
+    log.info("serve-scaler demo done: %s", summary)
+    if not ok:
+        log.error("serve-scaler audit failed: grows=%d shrinks=%d "
+                  "journal_matches=%s drained=%d hard_killed=%d "
+                  "final_slo_met=%s", len(grows), len(shrinks),
+                  journaled == actuated, len(drained),
+                  len(hard_killed), final_ok)
+    print("serve_summary=" + json.dumps(summary), flush=True)
+    if args.journal is None:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0 if ok else 1
 
 
 def run_p2p_demo(args) -> int:
@@ -390,6 +583,14 @@ def main(argv=None) -> int:
     parser.add_argument("--scaler-timeout", type=float, default=300.0)
     parser.add_argument("--journal", default=None,
                         help="--scaler: keep the decision journal here")
+    # serving elasticity demo (see run_serve_scaler_demo)
+    parser.add_argument("--serve-scaler", action="store_true",
+                        help="run the serving loop: store + teacher "
+                             "pool + load generator + SLO-driven "
+                             "scaler, self-audited grow + drained "
+                             "shrink")
+    parser.add_argument("--serve-phase-s", type=float, default=5.0,
+                        help="--serve-scaler: base load-phase seconds")
     # peer-to-peer migration demo (see run_p2p_demo)
     parser.add_argument("--resize-p2p", action="store_true",
                         help="run the live-migration loop: store + "
@@ -398,8 +599,11 @@ def main(argv=None) -> int:
     parser.add_argument("--p2p-timeout", type=float, default=120.0,
                         help="--resize-p2p: per-phase timeout seconds")
     args = parser.parse_args(argv)
-    if args.scaler and args.resize_p2p:
-        parser.error("--scaler and --resize-p2p are separate demos")
+    if sum((args.scaler, args.resize_p2p, args.serve_scaler)) > 1:
+        parser.error("--scaler, --serve-scaler and --resize-p2p are "
+                     "separate demos")
+    if args.serve_scaler:
+        return run_serve_scaler_demo(args)
     if args.resize_p2p:
         return run_p2p_demo(args)
     if args.scaler:

@@ -10,6 +10,14 @@ Flags are flag-else-env (`EDL_TPU_SCALER_*`; utils/config overlay).
 `--policy fairshare --budget N` scales several `--job`s against one
 node budget by marginal throughput — store-only (a `--server` holds a
 single job's state, so it cannot be combined with multiple `--job`).
+
+`--service NAME` adds a teacher pool to the loop (the serving
+elasticity plane, `scaler/serving.py`): its registrar-published
+latency/queue/utilization rollup drives a `ServingPolicy` targeting
+`--slo-p95-ms` (or, under `--policy fairshare`, the pool joins the
+trainer jobs in one budget water-fill). From this CLI the serving
+plane observes and journals only — the `TeacherPoolActuator` lives in
+the process that owns the pool.
 """
 
 from __future__ import annotations
@@ -31,6 +39,19 @@ def main(argv=None) -> int:
                         help="store endpoint (host:port or redis://...)")
     parser.add_argument("--job", action="append", default=[],
                         dest="jobs", help="job id (repeatable)")
+    parser.add_argument("--service", action="append", default=[],
+                        dest="services",
+                        help="teacher-pool service name to scale by its "
+                             "serving SLO (repeatable; observe/journal "
+                             "only from this CLI — live actuation runs "
+                             "where the pool runs, e.g. elastic_demo "
+                             "--serve-scaler or an embedded "
+                             "TeacherPoolActuator)")
+    parser.add_argument("--slo-p95-ms", type=float, default=None,
+                        help="serving SLO target "
+                             "(EDL_TPU_SERVE_SLO_P95_MS)")
+    parser.add_argument("--registry-root", default="edl_distill",
+                        help="service registry root for --service")
     parser.add_argument("--server", default=None,
                         help="JobServer host:port for limits + /resize")
     parser.add_argument("--policy", choices=("throughput", "fairshare"),
@@ -54,8 +75,8 @@ def main(argv=None) -> int:
     parser.add_argument("--once", action="store_true",
                         help="one tick (skips leader election), then exit")
     args = parser.parse_args(argv)
-    if not args.jobs:
-        parser.error("at least one --job is required")
+    if not args.jobs and not args.services:
+        parser.error("at least one --job or --service is required")
     if args.policy == "fairshare" and args.budget is None:
         parser.error("--policy fairshare requires --budget")
     if args.server and len(args.jobs) > 1:
@@ -76,13 +97,27 @@ def main(argv=None) -> int:
     policy = (FairSharePolicy(args.budget, **policy_kw)
               if args.policy == "fairshare"
               else ThroughputPolicy(**policy_kw))
+    serving_policy, serving_config = None, None
+    if args.services:
+        from edl_tpu.scaler.serving import ServingConfig, ServingPolicy
+        serve_overrides = {}
+        if args.slo_p95_ms is not None:
+            serve_overrides["slo_p95_ms"] = args.slo_p95_ms
+        serving_config = from_env(ServingConfig, **serve_overrides)
+        if args.policy != "fairshare":
+            # fairshare runs both planes itself (decide_mixed); the
+            # throughput policy pairs with a dedicated ServingPolicy
+            serving_policy = ServingPolicy(serving_config)
 
     from edl_tpu.coord.redis_store import connect_store
     store = connect_store(args.store)
     controller = ScalerController(
         store, args.jobs, policy, config=config,
         job_server=args.server, dry_run=args.dry_run,
-        journal_path=args.journal, elect=not args.once)
+        journal_path=args.journal, elect=not args.once,
+        services=args.services, serving_policy=serving_policy,
+        serving_config=serving_config,
+        registry_root=args.registry_root)
     try:
         if args.once:
             for entry in controller.tick():

@@ -14,6 +14,15 @@ no-ops, and on takeover the new leader replays the decision journal's
 tail to re-learn the throughput models and resume the cooldown clocks
 (so a leader crash never causes a double resize).
 
+The serving plane rides the same election and journal
+(``services=`` / ``serving_policy=`` / ``serving_actuate=``): teacher
+pools are digested from `Collector.service_rollup` into ServingViews,
+decided by a `ServingPolicy` (or jointly with the trainers by a budget
+policy exposing ``decide_mixed`` — FairShare), and actuated through a
+`TeacherPoolActuator`; their journal entries carry ``kind: "serving"``
++ ``service`` so each policy's replay finds its own
+(`scaler/serving.py`).
+
 Every decision — hold or resize, with its inputs and reason — is one
 JSON journal entry, appended both as a JSON line to ``journal_path``
 (observability; ``tail -f``-able) and under the store prefix
@@ -187,6 +196,11 @@ class ScalerController:
                  scope: str | None = None,
                  owner: str | None = None,
                  elect: bool = True,
+                 services: list[str] | tuple[str, ...] = (),
+                 serving_policy=None,
+                 serving_actuate: Callable[[str, int], dict] | None = None,
+                 serving_config=None,
+                 registry_root: str = "edl_distill",
                  clock: Callable[[], float] = time.time):
         self.store = store
         self.jobs = list(jobs)
@@ -201,8 +215,35 @@ class ScalerController:
         self.job_server = job_server
         self._actuate_fn = actuate
         self.dry_run = dry_run
+        # the serving plane, scaled side by side with the trainer jobs
+        # under the SAME leader election and journal: either its own
+        # ServingPolicy (serving_policy=...) or jointly with the
+        # trainers by a budget policy exposing decide_mixed (FairShare)
+        self.services = list(services)
+        self.serving_policy = serving_policy
+        self._serving_actuate = serving_actuate
+        self.serving_config = serving_config
+        self.registry_root = registry_root
+        self._service_collector = None
+        self._serving_desired: dict[str, int] = {}
+        if self.services:
+            if self.serving_policy is None \
+                    and not hasattr(self.policy, "decide_mixed"):
+                raise ValueError(
+                    "services need a serving_policy (ServingPolicy) or "
+                    "a budget policy with decide_mixed (FairSharePolicy)")
+            if self.serving_config is None:
+                from edl_tpu.scaler.serving import ServingConfig
+                from edl_tpu.utils.config import from_env
+                self.serving_config = from_env(ServingConfig)
+            self._service_collector = Collector(
+                store, services=tuple(self.services),
+                registry_root=registry_root)
         self.scope = scope or (self.jobs[0] if len(self.jobs) == 1
-                               else "cluster")
+                               else (self.services[0]
+                                     if not self.jobs
+                                     and len(self.services) == 1
+                                     else "cluster"))
         self.owner = owner or f"{socket.gethostname()}-{os.getpid()}"
         self.clock = clock
         self.journal = DecisionJournal(store, self.scope,
@@ -308,6 +349,33 @@ class ScalerController:
         log.info("measured elastic downtime for %s: %.2fs (ema %.2fs)",
                  job_id, measured, self._downtime[job_id])
 
+    def observe_service(self, service: str):
+        """Digest one `Collector.service_rollup` into the serving
+        policy's ServingView. ``desired`` is the last actuated target
+        (resize-in-flight detection: the actuator spawns/drains
+        asynchronously, so the registry trails the decision)."""
+        from edl_tpu.scaler.serving import ServingView
+        roll = self._service_collector.service_rollup(service)
+        cfg = self.serving_config
+        n = roll["n_teachers"]
+        desired = self._serving_desired.get(service)
+        if desired is not None and desired == n:
+            # the pool caught up with the target: back to steady state
+            del self._serving_desired[service]
+            desired = None
+        return ServingView(
+            service, n,
+            rows_per_sec=roll["rows_per_sec"],
+            util=roll["util"] if roll["util"] is not None else 0.0,
+            queue_depth=roll["queue_depth"],
+            latency_ms_p50=roll["latency_ms_p50"],
+            latency_ms_p95=roll["latency_ms_p95"],
+            slo_p95_ms=cfg.slo_p95_ms,
+            min_teachers=cfg.min_teachers,
+            max_teachers=cfg.max_teachers,
+            desired=desired,
+            fresh=bool(n and roll["reporting"]))
+
     # -- actuation ----------------------------------------------------------
 
     def _actuate(self, job_id: str, desired: int) -> dict:
@@ -327,6 +395,8 @@ class ScalerController:
         entries = self.journal.tail()
         if entries:
             self.policy.restore(entries)
+            if self.serving_policy is not None:
+                self.serving_policy.restore(entries)
             # replay measured downtimes too: a takeover leader must not
             # fall back to the configured constant when the journal
             # already recorded how fast this fleet really resizes
@@ -348,10 +418,20 @@ class ScalerController:
             self._restore_from_journal()
         now = self.clock() if now is None else now
         views = [self.observe(j, now) for j in self.jobs]
-        proposals = self.policy.decide(views, now)
+        serving_views = [self.observe_service(s) for s in self.services]
+        if serving_views and self.serving_policy is None:
+            # one budget policy governs both planes (FairShare mixed)
+            proposals, serving_props = self.policy.decide_mixed(
+                views, serving_views, now)
+        else:
+            proposals = self.policy.decide(views, now) if views else []
+            serving_props = (self.serving_policy.decide(serving_views, now)
+                             if serving_views else [])
         entries = []
         for view, prop in zip(views, proposals):
             entries.append(self._apply(view, prop, now))
+        for view, prop in zip(serving_views, serving_props):
+            entries.append(self._apply_serving(view, prop, now))
         return entries
 
     def _apply(self, view: JobView, prop: Proposal, now: float) -> dict:
@@ -394,6 +474,47 @@ class ScalerController:
                                if prop.predicted_gain is not None
                                else None)})
 
+    def _apply_serving(self, view, prop: Proposal, now: float) -> dict:
+        """Actuate + journal one serving-plane proposal. Entries carry
+        ``kind: "serving"`` + ``service`` (no ``job_id``), so trainer
+        policies skip them on replay and `ServingPolicy.restore` finds
+        its own."""
+        action, reason = "hold", prop.reason
+        applied = None
+        if prop.is_resize:
+            if self.dry_run:
+                action = "dry-run"
+            elif self._serving_actuate is None:
+                # observe-only deployments journal what they WOULD do
+                action, reason = "error", (f"{prop.reason}; no serving "
+                                           "actuation path")
+            else:
+                try:
+                    resp = self._serving_actuate(view.service, prop.desired)
+                    applied = int(resp.get("desired_teachers",
+                                           prop.desired))
+                    action = "resize"
+                    if resp.get("clamped"):
+                        reason += "; clamped by actuator"
+                    self._serving_desired[view.service] = applied
+                    pol = self.serving_policy or self.policy
+                    pol.notify_resized(view.service, applied, now)
+                    log.info("resize pool %s: %d -> %d (%s)", view.service,
+                             prop.current, applied, prop.reason)
+                except Exception as exc:  # noqa: BLE001 — journal it; a
+                    # dead actuator must not kill the control loop
+                    action, reason = "error", f"{prop.reason}; {exc}"
+        return self.journal.append({
+            "ts": now, "kind": "serving", "service": view.service,
+            "leader": self.owner, "n_teachers": view.n_teachers,
+            "rows_per_sec": round(view.rows_per_sec, 2),
+            "util": round(view.util, 4),
+            "queue_depth": view.queue_depth,
+            "latency_ms_p95": view.latency_ms_p95,
+            "slo_p95_ms": view.slo_p95_ms, "fresh": view.fresh,
+            "current": prop.current, "desired": prop.desired,
+            "applied": applied, "action": action, "reason": reason})
+
     # -- event-driven pacing -------------------------------------------------
 
     def _start_util_watches(self) -> None:
@@ -403,14 +524,22 @@ class ScalerController:
         no-traffic fallback. Unavailable/disabled watches leave the
         original fixed-interval loop untouched."""
         from edl_tpu.coord.collector import util_prefix
+        from edl_tpu.coord.registry import ServiceRegistry
         from edl_tpu.coord.store import try_watch
-        for job in self.jobs:
-            watch = try_watch(self.store, util_prefix(job))
+        prefixes = [(job, util_prefix(job)) for job in self.jobs]
+        if self.services:
+            # registrar stats updates land on the service registry
+            # prefix: the serving plane ticks at event latency too
+            registry = ServiceRegistry(self.store, root=self.registry_root)
+            prefixes += [(svc, registry.service_prefix(svc))
+                         for svc in self.services]
+        for name, prefix in prefixes:
+            watch = try_watch(self.store, prefix)
             if watch is None:
                 continue
             thread = threading.Thread(target=self._pump_kicks, args=(watch,),
                                       daemon=True,
-                                      name=f"edl-scaler-watch-{job}")
+                                      name=f"edl-scaler-watch-{name}")
             thread.start()
             self._util_watches.append((watch, thread))
         if self._util_watches:

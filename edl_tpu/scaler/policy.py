@@ -309,10 +309,11 @@ class FairSharePolicy(_PolicyBase):
         super().__init__(**kw)
         self.budget = budget
 
-    def plan(self, views: list[JobView]) -> dict[str, int]:
+    def plan(self, views: list[JobView],
+             budget: int | None = None) -> dict[str, int]:
         """The budget split this tick's models recommend."""
         alloc: dict[str, int] = {}
-        left = self.budget
+        left = self.budget if budget is None else budget
         for v in views:  # mins first, in view order, never past budget
             grant = min(v.min_nodes, max(left, 0))
             alloc[v.job_id] = grant
@@ -395,3 +396,112 @@ class FairSharePolicy(_PolicyBase):
                                           "fair-share-shrink")
             total += desired - v.effective_desired
         return [proposals[v.job_id] for v in views]
+
+    # -- teacher pools in the same budget ------------------------------------
+    # (`scaler/serving.ServingView`, duck-typed: policy.py stays free of
+    # the serving plane's imports)
+
+    @staticmethod
+    def pool_demand(view) -> int:
+        """Teachers this pool's SLO predicts it needs — the serving
+        plane's bid in the water-fill. Capacity scales latency ~ 1/m
+        (the pool serves an open-loop arrival stream), so hold the
+        predicted p95 at 75% of the SLO; when there is no latency
+        signal yet, bound utilization at 0.75 instead. The max of the
+        two is the demand: latency is the contract, utilization the
+        early warning."""
+        n = max(1, view.n_teachers)
+        need = view.min_teachers
+        if view.latency_ms_p95 and view.slo_p95_ms:
+            need = max(need, math.ceil(
+                n * view.latency_ms_p95 / (0.75 * view.slo_p95_ms)))
+        if view.util:
+            need = max(need, math.ceil(n * view.util / 0.75))
+        return max(view.min_teachers, min(view.max_teachers, need))
+
+    def plan_mixed(self, trainer_views: list[JobView], serving_views
+                   ) -> tuple[dict[str, int], dict[str, int]]:
+        """One node budget across trainer worlds AND teacher pools.
+        Pools are granted their predicted SLO demand FIRST — serving is
+        user-facing, so SLO headroom outranks batch throughput — and
+        trainers water-fill the remainder by predicted marginal
+        throughput. Returns ``(trainer_alloc, pool_alloc)``."""
+        pool_alloc: dict[str, int] = {}
+        left = self.budget
+        for v in serving_views:
+            grant = min(self.pool_demand(v), max(left, 0))
+            pool_alloc[v.service] = grant
+            left -= grant
+        trainer_alloc = self.plan(trainer_views, budget=max(left, 0))
+        return trainer_alloc, pool_alloc
+
+    def decide_mixed(self, trainer_views: list[JobView], serving_views,
+                     now: float) -> tuple[list[Proposal], list[Proposal]]:
+        """`decide` with teacher pools in the budget: one joint
+        shrink-before-grow reconcile across BOTH planes, so a pool's
+        SLO grow can be funded by a trainer shrink within the same
+        tick's accounting and the live total never transiently exceeds
+        the budget. Returns proposals per plane, each in view order.
+        (Cooldown state is keyed by id: a job and a service sharing a
+        name would share a cooldown clock — don't do that.)"""
+        trainer_alloc, pool_alloc = self.plan_mixed(trainer_views,
+                                                    serving_views)
+        # (kind, id, view, target, hold-reason)
+        rows: list[tuple[str, str, object, int, str | None]] = []
+        for v in trainer_views:
+            rows.append(("trainer", v.job_id, v, trainer_alloc[v.job_id],
+                         self._intake(v, now)))
+        for v in serving_views:
+            hold = None
+            if not v.fresh or v.n_teachers < 1:
+                hold = "no-fresh-serving-stats"
+            elif v.effective_desired != v.n_teachers:
+                hold = "resize-in-flight"
+            else:
+                resized_at = self._resized_at.get(v.service)
+                if resized_at is not None \
+                        and now - resized_at < self.cooldown_s:
+                    hold = "cooldown"
+            rows.append(("serving", v.service, v, pool_alloc[v.service],
+                         hold))
+        proposals: dict[str, Proposal] = {}
+        total = sum(v.effective_desired for _, _, v, _, _ in rows)
+        for kind, rid, v, desired, hold in sorted(
+                rows, key=lambda r: r[3] - r[2].effective_desired):
+            cur = v.world_size if kind == "trainer" else v.n_teachers
+            if hold is not None:
+                proposals[rid] = Proposal(rid, cur, cur, hold)
+                continue
+            if desired == cur:
+                proposals[rid] = Proposal(rid, cur, cur, "converged")
+                continue
+            delta = desired - v.effective_desired
+            if delta > 0:
+                gain = None
+                if kind == "trainer":
+                    model = self.model(rid)
+                    t0, t1 = model.predict(cur), model.predict(desired)
+                    gain = (t1 - t0) if t0 is not None and t1 is not None \
+                        else None
+                    if gain is not None and gain <= 0:
+                        proposals[rid] = Proposal(rid, cur, cur,
+                                                  "no-marginal-gain", gain)
+                        continue
+                    if gain is not None and not self._amortizes(gain, v):
+                        proposals[rid] = Proposal(rid, cur, cur,
+                                                  "grow-unamortized", gain)
+                        continue
+                if total + delta > self.budget:
+                    proposals[rid] = Proposal(rid, cur, cur,
+                                              "awaiting-budget", gain)
+                    continue
+                reason = ("fair-share-grow" if kind == "trainer"
+                          else "slo-fair-share-grow")
+                proposals[rid] = Proposal(rid, cur, desired, reason, gain)
+            else:
+                reason = ("fair-share-shrink" if kind == "trainer"
+                          else "slo-fair-share-shrink")
+                proposals[rid] = Proposal(rid, cur, desired, reason)
+            total += desired - v.effective_desired
+        return ([proposals[v.job_id] for v in trainer_views],
+                [proposals[v.service] for v in serving_views])

@@ -18,6 +18,7 @@ from the TRUE noise-free curve) plus the downtime the policy paid.
 
 from __future__ import annotations
 
+import math
 import random
 from dataclasses import dataclass, field
 from typing import Callable
@@ -155,6 +156,174 @@ class SimCluster:
             alloc[best_job] += 1
             left -= 1
         return alloc
+
+
+# -- the serving pool (SLO-driven elasticity; scaler/serving.py) -------------
+
+
+@dataclass(frozen=True)
+class ArrivalTrace:
+    """Open-loop arrival rate (rows/sec) as a function of the tick."""
+
+    name: str
+    rate: Callable[[int], float]
+
+    def __call__(self, tick: int) -> float:
+        return max(0.0, float(self.rate(tick)))
+
+
+def steady(lam: float = 200.0) -> ArrivalTrace:
+    """Constant demand: the no-thrash baseline."""
+    return ArrivalTrace(f"steady({lam:g})", lambda t: lam)
+
+
+def step(lam: float = 100.0, factor: float = 4.0,
+         at: int = 40) -> ArrivalTrace:
+    """Demand jumps ``factor``x at tick ``at`` and stays: the SLO
+    recovery case."""
+    return ArrivalTrace(f"step({lam:g}x{factor:g}@{at})",
+                        lambda t: lam * factor if t >= at else lam)
+
+
+def burst(lam: float = 100.0, factor: float = 4.0, at: int = 40,
+          length: int = 20) -> ArrivalTrace:
+    """Demand spikes ``factor``x for ``length`` ticks then returns:
+    grow in, drain out."""
+    return ArrivalTrace(f"burst({lam:g}x{factor:g}@{at}+{length})",
+                        lambda t: lam * factor if at <= t < at + length
+                        else lam)
+
+
+class SimServingPool:
+    """Deterministic open-loop serving pool the `ServingPolicy` runs
+    against: arrivals from a trace, capacity = ready teachers x
+    ``teacher_rate`` rows/sec, explicit backlog dynamics.
+
+    The latency model is queueing-naive but directionally honest:
+    p95 = ``base_ms / (1 - rho)`` (service-time inflation as load
+    approaches capacity, rho clamped at 0.95) plus the time the current
+    backlog takes to drain at full capacity. Seeded multiplicative
+    noise on top. A grow takes ``spawn_delay_ticks`` before the new
+    teacher counts (the view's ``desired`` stays ahead of
+    ``n_teachers`` meanwhile — exactly the live resize-in-flight
+    signal); a shrink drains within the tick, so — unlike trainer
+    resizes — serving NEVER pays a fresh=False downtime window. That
+    asymmetry is the whole point of keep-then-fill.
+    """
+
+    def __init__(self, service: str, trace: ArrivalTrace, *,
+                 teacher_rate: float = 250.0, base_ms: float = 20.0,
+                 slo_p95_ms: float = 250.0, teachers: int = 1,
+                 min_teachers: int = 1, max_teachers: int = 16,
+                 spawn_delay_ticks: int = 2, tick_s: float = 1.0,
+                 request_rows: int = 16, noise: float = 0.0,
+                 seed: int = 0):
+        from edl_tpu.scaler.serving import ServingView
+        self._view_cls = ServingView
+        self.service = service
+        self.trace = trace
+        self.teacher_rate = teacher_rate
+        self.base_ms = base_ms
+        self.slo_p95_ms = slo_p95_ms
+        self.min_teachers = min_teachers
+        self.max_teachers = max_teachers
+        self.spawn_delay_ticks = spawn_delay_ticks
+        self.tick_s = tick_s
+        self.request_rows = request_rows
+        self.noise = noise
+        self._rng = random.Random(seed)
+        self.ready = teachers
+        self.desired = teachers
+        self._pending_spawns: list[int] = []  # tick each becomes ready
+        self.backlog_rows = 0.0
+        self.now = 0.0
+        self.ticks = 0
+        self.resizes = 0
+        self.resize_ticks: list[int] = []
+
+    def tick(self):
+        """Advance one interval; emit the rollup-shaped ServingView."""
+        self.ticks += 1
+        self.now += self.tick_s
+        ready_now = sum(1 for t in self._pending_spawns if t <= self.ticks)
+        self.ready += ready_now
+        self._pending_spawns = [t for t in self._pending_spawns
+                                if t > self.ticks]
+        lam = self.trace(self.ticks)
+        cap = self.ready * self.teacher_rate
+        arrived = lam * self.tick_s
+        served = min(self.backlog_rows + arrived, cap * self.tick_s)
+        self.backlog_rows = max(0.0,
+                                self.backlog_rows + arrived - served)
+        rho = lam / cap if cap > 0 else float("inf")
+        wait_ms = (self.backlog_rows / cap) * 1e3 if cap > 0 else 0.0
+        p95 = self.base_ms / max(1.0 - min(rho, 0.95), 0.05) + wait_ms
+        p95 *= max(0.0, 1.0 + self._rng.gauss(0.0, self.noise))
+        p50 = self.base_ms + wait_ms
+        return self._view_cls(
+            self.service, self.ready,
+            rows_per_sec=round(served / self.tick_s, 2),
+            util=min(1.0, rho),
+            queue_depth=int(self.backlog_rows // self.request_rows),
+            latency_ms_p50=round(p50, 2), latency_ms_p95=round(p95, 2),
+            slo_p95_ms=self.slo_p95_ms, min_teachers=self.min_teachers,
+            max_teachers=self.max_teachers, desired=self.desired)
+
+    def resize(self, desired: int) -> int:
+        """Actuate: spawn after a delay, drain within the tick."""
+        desired = max(self.min_teachers, min(self.max_teachers, desired))
+        total = self.ready + len(self._pending_spawns)
+        if desired > total:
+            for _ in range(desired - total):
+                self._pending_spawns.append(self.ticks
+                                            + self.spawn_delay_ticks)
+        elif desired < total:
+            drop = total - desired
+            while drop and self._pending_spawns:  # cancel unspawned first
+                self._pending_spawns.pop()
+                drop -= 1
+            self.ready -= drop
+        if desired != total:
+            self.resizes += 1
+            self.resize_ticks.append(self.ticks)
+        self.desired = desired
+        return desired
+
+    def oracle_teachers(self, lam: float) -> int:
+        """Smallest pool whose steady-state p95 meets the SLO at
+        arrival rate ``lam`` (from the true noise-free model):
+        base/(1-rho) <= slo  =>  n >= lam / (rate * (1 - base/slo))."""
+        headroom = 1.0 - self.base_ms / self.slo_p95_ms
+        if headroom <= 0:
+            return self.max_teachers
+        need = math.ceil(lam / (self.teacher_rate * headroom))
+        return max(self.min_teachers,
+                   min(self.max_teachers, max(1, need)))
+
+
+def run_serving_policy(pool: SimServingPool, policy, *,
+                       ticks: int = 120, settle_ticks: int = 40) -> dict:
+    """Drive a `ServingPolicy` over the pool; summarize SLO attainment
+    and convergence. ``last_violation_tick`` is the recovery anchor:
+    for a step trace, reaction = last_violation_tick - step tick."""
+    ok: list[bool] = []
+    for _ in range(ticks):
+        view = pool.tick()
+        ok.append(view.latency_ms_p95 <= view.slo_p95_ms)
+        (prop,) = policy.decide([view], pool.now)
+        if prop.is_resize:
+            actual = pool.resize(prop.desired)
+            policy.notify_resized(view.service, actual, pool.now)
+    post = sum(1 for t in pool.resize_ticks if t > ticks - settle_ticks)
+    return {"ticks": ticks, "trace": pool.trace.name,
+            "slo_attainment": round(sum(ok) / len(ok), 4),
+            "last_violation_tick": max(
+                (i + 1 for i, good in enumerate(ok) if not good),
+                default=0),
+            "final_teachers": pool.ready,
+            "resizes": pool.resizes,
+            "post_convergence_resizes": post,
+            "resize_ticks": list(pool.resize_ticks)}
 
 
 def run_policy(cluster: SimCluster, policy: ScalingPolicy, *,

@@ -198,6 +198,40 @@ def test_utilization_breaks_ties_toward_idle_teachers():
     assert len(used) == 4 and "t0" not in used, used
 
 
+def test_queue_depth_sheds_new_clients_off_backlogged_teacher():
+    """Queue-aware weight (serving SLO satellite): with skewed queue
+    depths a backlogged teacher loses the tie even against a HIGHER
+    utilization on an empty-queue rival — backlog is the leading
+    indicator of the latency violation util only trails."""
+    svc = ServiceBalance("s")
+    svc.set_servers(["backlogged", "working", "idle"])
+    # "backlogged" looks cheapest by util alone, but 10 queued requests
+    # say otherwise; "working" runs hotter but keeps its queue empty
+    svc.set_utilization({"backlogged": 0.2, "working": 0.7, "idle": 0.3},
+                        {"backlogged": 10, "working": 0, "idle": 0})
+    svc.add_client("c0")
+    svc.add_client("c1")
+    svc.rebalance()
+    check_invariants(svc)
+    used = {s for c in ("c0", "c1") for s in svc.get(c).servers}
+    # client_cap = 3//2 = 1 -> one teacher idles; it must be the
+    # backlogged one, not the higher-util one
+    assert used == {"working", "idle"}, used
+
+
+def test_queue_depth_unknown_defaults_to_zero():
+    """A teacher without a queue report competes on util alone — the
+    absence of a backlog signal must not penalize (or favor) it; a
+    reported backlog adds QUEUE_WEIGHT per queued request."""
+    svc = ServiceBalance("s")
+    svc.set_utilization({"a": 0.4}, {"a": 0})
+    assert svc._busy("a") == 0.4
+    assert svc._busy("unknown") == 0.5      # neutral util + no queue term
+    svc.set_utilization({"a": 0.4}, {"a": 3})
+    assert abs(svc._busy("a")
+               - (0.4 + 3 * ServiceBalance.QUEUE_WEIGHT)) < 1e-9
+
+
 def test_unknown_utilization_is_neutral_not_idle():
     """A non-reporting teacher must not beat one honestly reporting a
     small util (it could be saturated for all we know); it must still

@@ -1,0 +1,606 @@
+"""Serving-elasticity plane: SLO policy, drain actuation, mixed budget.
+
+The serving counterpart of test_scaler.py (ROADMAP item 2): policy
+behavior is pinned against the deterministic `SimServingPool` (virtual
+time, seeded noise, SLO oracles from the true queueing model); the
+actuation tier drives REAL in-process `TeacherServer`s + registrars
+over InMemStore — including the graceful-drain protocol and its
+hard-kill fallback; the controller tier runs serving and trainer
+policies side by side off one journal.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from edl_tpu.coord.collector import Collector
+from edl_tpu.coord.registry import ServiceRegistry
+from edl_tpu.coord.store import InMemStore
+from edl_tpu.scaler.controller import ScalerConfig, ScalerController
+from edl_tpu.scaler.policy import FairSharePolicy, JobView, ThroughputPolicy
+from edl_tpu.scaler.serving import (LocalTeacher, ServingConfig,
+                                    ServingPolicy, ServingView,
+                                    TeacherPoolActuator, selftest)
+from edl_tpu.scaler.simulator import (SimServingPool, burst,
+                                      run_serving_policy, steady, step)
+
+ROOT = "edl_distill"
+
+
+def make_policy(**kw):
+    kw.setdefault("slo_p95_ms", 250.0)
+    kw.setdefault("breach_ticks", 2)
+    kw.setdefault("idle_ticks", 5)
+    kw.setdefault("cooldown_s", 15.0)
+    kw.setdefault("max_teachers", 16)
+    return ServingPolicy(ServingConfig(**kw))
+
+
+class TestServingPolicy:
+    def test_steady_load_never_resizes(self):
+        """The no-thrash bar: steady in-SLO load, zero resizes, 100%
+        attainment."""
+        pool = SimServingPool("s", steady(200.0), teachers=1, tick_s=1.0,
+                              seed=0)
+        out = run_serving_policy(pool, make_policy(), ticks=150)
+        assert out["resizes"] == 0
+        assert out["slo_attainment"] == 1.0
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_step_restores_slo_within_bound(self, seed):
+        """The acceptance bar: after a 4x load step the SLO is restored
+        within a bounded number of ticks, the pool converges to the
+        oracle size, and steady state stays resize-free."""
+        at = 40
+        pool = SimServingPool("s", step(100.0, 4.0, at=at), teachers=1,
+                              tick_s=1.0, noise=0.01, seed=seed)
+        out = run_serving_policy(pool, make_policy(), ticks=160,
+                                 settle_ticks=50)
+        assert out["last_violation_tick"] - at <= 25, out
+        assert out["final_teachers"] == pool.oracle_teachers(400.0), out
+        assert out["post_convergence_resizes"] == 0, out
+
+    def test_burst_grows_in_and_drains_out(self):
+        """A bounded burst: the pool grows into it and idles back down
+        to the steady oracle after it passes."""
+        pool = SimServingPool("s", burst(100.0, 4.0, at=30, length=25),
+                              teachers=1, tick_s=1.0, seed=0)
+        out = run_serving_policy(pool, make_policy(), ticks=200)
+        assert out["resizes"] >= 2, out
+        assert out["final_teachers"] == pool.oracle_teachers(100.0), out
+        assert out["post_convergence_resizes"] == 0, out
+
+    def test_dead_zone_holds_between_idle_and_breach(self):
+        """Asymmetric hysteresis: a pool between the low-water mark and
+        the SLO never resizes — the dead zone is where it LIVES."""
+        policy = make_policy(util_low=0.3)
+        view = ServingView("s", 2, util=0.6, queue_depth=1,
+                           latency_ms_p95=150.0, slo_p95_ms=250.0)
+        for tick in range(50):
+            (prop,) = policy.decide([view], float(tick))
+            assert not prop.is_resize and prop.reason == "in-band"
+
+    def test_sustained_breach_required(self):
+        """One bad sample never grows the pool (breach_ticks filter)."""
+        policy = make_policy(breach_ticks=3)
+        bad = ServingView("s", 1, util=1.0, latency_ms_p95=900.0)
+        good = ServingView("s", 1, util=0.5, latency_ms_p95=100.0)
+        for now, view in ((1.0, bad), (2.0, good), (3.0, bad), (4.0, bad)):
+            (prop,) = policy.decide([view], now)
+            assert not prop.is_resize  # streak broken by the good tick
+        (prop,) = policy.decide([bad], 5.0)
+        assert prop.is_resize and prop.reason == "slo-breach-grow"
+
+    def test_grow_is_multiplicative_but_bounded(self):
+        """A deep breach grows by grow_max_factor at most (and by at
+        least one teacher) — fast recovery without one sample
+        quadrupling the pool."""
+        policy = make_policy(breach_ticks=1, grow_max_factor=2.0)
+        view = ServingView("s", 4, util=1.0, queue_depth=100,
+                           latency_ms_p95=5000.0, slo_p95_ms=250.0)
+        (prop,) = policy.decide([view], 1.0)
+        assert prop.desired == 8  # 4 * min(20x, 2.0)
+
+    def test_backlog_draining_holds(self):
+        """A breach whose queue is already paying down under existing
+        capacity holds instead of growing: more teachers cannot drain
+        faster than the arrival deficit already does."""
+        policy = make_policy(breach_ticks=1)
+        over = ServingView("s", 2, util=0.5, queue_depth=40,
+                           latency_ms_p95=900.0)
+        (prop,) = policy.decide([over], 1.0)
+        assert prop.is_resize  # first look: no trend yet, act on breach
+
+    def test_backlog_draining_trend_suppresses_grow(self):
+        policy = make_policy(breach_ticks=2)
+        v1 = ServingView("s", 2, util=0.5, queue_depth=40,
+                         latency_ms_p95=900.0)
+        v2 = ServingView("s", 2, util=0.5, queue_depth=25,
+                         latency_ms_p95=700.0)
+        v3 = ServingView("s", 2, util=0.5, queue_depth=10,
+                         latency_ms_p95=400.0)
+        policy.decide([v1], 1.0)
+        for now, v in ((2.0, v2), (3.0, v3)):
+            (prop,) = policy.decide([v], now)
+            assert not prop.is_resize and prop.reason == "backlog-draining"
+
+    def test_cooldown_spaces_resizes_but_streaks_accumulate(self):
+        """No two resizes inside the cooldown; the breach streak keeps
+        counting DURING cooldown so the first post-cooldown decision
+        acts immediately."""
+        policy = make_policy(breach_ticks=2, cooldown_s=10.0)
+        bad = ServingView("s", 1, util=1.0, latency_ms_p95=900.0)
+        policy.decide([bad], 1.0)
+        (prop,) = policy.decide([bad], 2.0)
+        assert prop.is_resize
+        policy.notify_resized("s", 2, 2.0)
+        bad2 = ServingView("s", 2, util=1.0, latency_ms_p95=900.0)
+        for now in (3.0, 5.0, 9.0, 11.0):
+            (prop,) = policy.decide([bad2], now)
+            assert prop.reason == "cooldown"
+        (prop,) = policy.decide([bad2], 12.5)
+        assert prop.is_resize  # streak was already sustained
+
+    def test_idle_shrink_is_one_at_a_time(self):
+        policy = make_policy(idle_ticks=3, cooldown_s=1.0)
+        idle = ServingView("s", 4, util=0.05, queue_depth=0,
+                           latency_ms_p95=30.0)
+        props = [policy.decide([idle], float(t))[0] for t in range(3)]
+        assert not any(p.is_resize for p in props[:2])
+        assert props[2].is_resize and props[2].desired == 3
+
+    def test_restore_resumes_cooldown_from_serving_entries(self):
+        """Journal replay: a takeover scaler must not re-resize inside
+        the predecessor's cooldown; trainer entries are ignored."""
+        policy = make_policy(cooldown_s=20.0, breach_ticks=1)
+        now = 1000.0
+        policy.restore([
+            {"job_id": "trainer_job", "action": "resize", "ts": now - 1},
+            {"kind": "serving", "service": "s", "action": "resize",
+             "ts": now - 5.0},
+        ])
+        bad = ServingView("s", 2, util=1.0, latency_ms_p95=900.0)
+        (prop,) = policy.decide([bad], now)
+        assert prop.reason == "cooldown"
+        (prop,) = policy.decide([bad], now + 16.0)
+        assert prop.is_resize
+
+    def test_fresh_and_inflight_gates(self):
+        policy = make_policy(breach_ticks=1)
+        stale = ServingView("s", 2, latency_ms_p95=900.0, fresh=False)
+        (prop,) = policy.decide([stale], 1.0)
+        assert prop.reason == "no-fresh-serving-stats"
+        inflight = ServingView("s", 2, latency_ms_p95=900.0, desired=3)
+        (prop,) = policy.decide([inflight], 2.0)
+        assert prop.reason == "resize-in-flight"
+
+    def test_selftest_passes(self):
+        """The CI smoke is green from inside the suite too."""
+        assert selftest(verbose=False) == 0
+
+
+# -- actuation: real teachers, real drains -----------------------------------
+
+
+def make_slow_teacher(store, service, *, per_row_s=0.0, gate=None):
+    """Spawn an in-process TeacherServer (+registrar) whose predict
+    optionally sleeps per row or blocks on `gate` (drain-window
+    control)."""
+    from edl_tpu.distill.registrar import TeacherRegistrar
+    from edl_tpu.distill.teacher_server import TeacherServer
+
+    def predict(feeds):
+        if gate is not None:
+            gate.wait(timeout=10.0)
+        if per_row_s:
+            rows = next(iter(feeds.values())).shape[0]
+            time.sleep(rows * per_row_s)
+        rows = next(iter(feeds.values())).shape[0]
+        return {"logits": np.zeros((rows, 2), np.float32)}
+
+    server = TeacherServer(predict, port=0, host="127.0.0.1",
+                           max_batch=16, max_wait=0.001).start()
+    registrar = TeacherRegistrar(store, service,
+                                 f"127.0.0.1:{server.port}", ttl=5.0,
+                                 stats_interval=0.1, probe_timeout=5.0)
+    registrar.start()
+    return LocalTeacher(server, registrar)
+
+
+class TestTeacherPoolActuator:
+    def test_grow_spawns_and_registers(self):
+        store = InMemStore()
+        actuator = TeacherPoolActuator(
+            lambda i: make_slow_teacher(store, "svc"), max_teachers=4,
+            service="svc")
+        try:
+            resp = actuator.resize(2)
+            assert resp == {"desired_teachers": 2, "requested": 2,
+                            "clamped": False}
+            assert actuator.pool_size() == 2
+            registry = ServiceRegistry(store, root=ROOT)
+            assert len(registry.get_service("svc")) == 2
+            assert actuator.resize(9)["clamped"] is True
+        finally:
+            actuator.close()
+
+    def test_graceful_drain_deregisters_first_and_completes_inflight(self):
+        """The drain protocol end-to-end: the shrinking pool deregisters
+        the victim immediately (discovery stops handing it out while the
+        server still LIVES), an in-flight request completes against the
+        draining server, and only then does it stop."""
+        from edl_tpu.distill.teacher_server import TeacherClient
+        store = InMemStore()
+        gate = threading.Event()
+        teachers = []
+
+        def spawn(i):
+            # first teacher free-running, second one gate-controlled so
+            # the test owns the drain window
+            t = make_slow_teacher(store, "svc",
+                                  gate=gate if i == 1 else None)
+            teachers.append(t)
+            return t
+
+        actuator = TeacherPoolActuator(spawn, max_teachers=4,
+                                       drain_deadline_s=10.0,
+                                       drain_poll_s=0.02, service="svc")
+        registry = ServiceRegistry(store, root=ROOT)
+        try:
+            actuator.resize(2)
+            victim = teachers[1]  # LIFO: the newest retires first
+            client = TeacherClient(victim.endpoint, timeout=10.0)
+            pending = client.predict_async(
+                {"x": np.zeros((4, 2), np.float32)})  # blocked on gate
+            time.sleep(0.1)
+            actuator.resize(1)
+            deadline = time.monotonic() + 5.0
+            while len(registry.get_service("svc")) != 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # deregistered from discovery BEFORE the server stopped:
+            assert len(registry.get_service("svc")) == 1
+            assert victim.stats() is not None, "server gone before drain"
+            assert not actuator.drain_log, "drain finished with work live"
+            gate.set()  # let the in-flight request finish
+            out = pending.result()  # completes, no connection reset
+            assert out["logits"].shape == (4, 2)
+            assert actuator.wait_drains(timeout=10.0)
+            (entry,) = actuator.drain_log
+            assert entry["drained"] and not entry["hard_killed"], entry
+            client.close()
+        finally:
+            gate.set()
+            actuator.close()
+
+    def test_drain_deadline_hard_kill_fallback(self):
+        """A teacher that never quiets (stats always show work) is
+        hard-killed at the deadline — recorded, never silent."""
+        killed = threading.Event()
+
+        class StuckTeacher:
+            endpoint = "stuck:1"
+
+            def stats(self):
+                return {"queue_depth": 1, "inflight_groups": 1}
+
+            def deregister(self):
+                pass
+
+            def stop(self):
+                raise AssertionError("graceful stop on a stuck teacher")
+
+            def kill(self):
+                killed.set()
+
+        actuator = TeacherPoolActuator(lambda i: StuckTeacher(),
+                                       min_teachers=0, max_teachers=2,
+                                       drain_deadline_s=0.3,
+                                       drain_poll_s=0.02, service="svc")
+        actuator.resize(1)
+        actuator.resize(0)
+        assert actuator.wait_drains(timeout=5.0)
+        assert killed.is_set()
+        (entry,) = actuator.drain_log
+        assert entry["hard_killed"] and not entry["drained"]
+        assert entry["wait_s"] >= 0.3
+
+    def test_balancer_reassigns_readers_keep_then_fill(self):
+        """The balancer half of the drain story: when the drained
+        teacher leaves the registry, a client's next heartbeat delivers
+        a re-versioned server set that keeps its surviving teacher and
+        fills from the remaining pool."""
+        from edl_tpu.distill.discovery_server import BalanceTable
+        store = InMemStore()
+        registry = ServiceRegistry(store, root=ROOT)
+        regs = {ep: registry.register("svc", ep)
+                for ep in ("t0:1", "t1:1", "t2:1")}
+        table = BalanceTable(store, "disc:1", root=ROOT)
+        resp = table.register("reader", "svc")
+        assert resp["status"] == "OK"
+        before = set(resp["servers"])
+        assert len(before) == 3  # client_cap = 3//1
+        # drain t1: deregister-first, exactly what the actuator does
+        regs["t1:1"].stop()
+        registry.deregister("svc", "t1:1")
+        table.tick()
+        hb = table.heartbeat("reader", "svc", resp["version"])
+        assert hb["version"] > resp["version"]
+        after = set(hb["servers"])
+        assert "t1:1" not in after
+        # keep-then-fill: every surviving old link is retained
+        assert before - {"t1:1"} <= after
+        for reg in regs.values():
+            reg.stop()
+
+
+# -- the latency histogram (SLO signal source) -------------------------------
+
+
+class TestLatencyHistogram:
+    def test_quantiles_from_known_histogram(self):
+        from edl_tpu.distill.teacher_server import latency_quantile
+        hist = {"10.0": 90, "100.0": 9, "1000.0": 1}
+        assert latency_quantile(hist, 0.5) == 10.0
+        assert latency_quantile(hist, 0.95) == 100.0
+        assert latency_quantile(hist, 0.999) == 1000.0
+        assert latency_quantile({}, 0.5) is None
+        assert latency_quantile({"10.0": 0}, 0.5) is None
+
+    def test_server_stats_carry_latency_quantiles(self):
+        """A served request lands in the histogram; a slow predict_fn
+        pushes p95 at least past its sleep."""
+        from edl_tpu.distill.teacher_server import (TeacherClient,
+                                                    TeacherServer)
+
+        def predict(feeds):
+            time.sleep(0.06)
+            rows = next(iter(feeds.values())).shape[0]
+            return {"y": np.zeros((rows, 2), np.float32)}
+
+        with TeacherServer(predict, port=0, host="127.0.0.1") as server:
+            client = TeacherClient(f"127.0.0.1:{server.port}",
+                                   timeout=10.0)
+            for _ in range(3):
+                client.predict({"x": np.zeros((2, 2), np.float32)})
+            stats = client.stats()
+            client.close()
+        assert stats["latency_ms_p95"] >= 50.0
+        assert stats["latency_ms_p50"] >= 50.0
+        assert sum(stats["latency_hist_ms"].values()) == 3
+        assert stats["inflight_groups"] == 0
+
+    def test_registrar_info_windows_the_histogram(self):
+        """The registrar publishes WINDOWED p50/p95: a teacher that
+        turns slow shows up within one stats interval even with a fast
+        cumulative past."""
+        from edl_tpu.distill.registrar import TeacherRegistrar
+        registrar = TeacherRegistrar(InMemStore(), "svc", "h:1")
+        fast_past = {"served_rows": 1000, "busy_s": 1.0, "queue_depth": 0,
+                     "batch_rows_hist": {"16": 100},
+                     "latency_hist_ms": {"10.0": 1000}}
+        now_slow = {"served_rows": 1100, "busy_s": 2.0, "queue_depth": 7,
+                    "inflight_groups": 1,
+                    "batch_rows_hist": {"16": 110},
+                    "latency_hist_ms": {"10.0": 1000, "1000.0": 100}}
+        info = json.loads(registrar._utilization_info(now_slow, fast_past,
+                                                      dt=5.0))
+        assert info["latency_ms_p50"] == 1000.0  # the window is ALL slow
+        assert info["latency_ms_p95"] == 1000.0
+        assert info["queue_depth"] == 7
+        assert info["inflight_groups"] == 1
+        # cumulative view would have said p50=10ms
+        cold = json.loads(registrar._utilization_info(now_slow, None,
+                                                      dt=5.0))
+        assert cold["latency_ms_p50"] == 10.0
+
+    def test_collector_rollup_takes_worst_teacher_tail(self):
+        store = InMemStore()
+        registry = ServiceRegistry(store, root=ROOT)
+        registry.register_permanent("svc", "h:1", info=json.dumps(
+            {"rows_per_sec": 100.0, "util": 0.2, "queue_depth": 1,
+             "latency_ms_p95": 40.0, "latency_ms_p50": 10.0}))
+        registry.register_permanent("svc", "h:2", info=json.dumps(
+            {"rows_per_sec": 50.0, "util": 0.8, "queue_depth": 5,
+             "latency_ms_p95": 400.0, "latency_ms_p50": 100.0}))
+        registry.register_permanent("svc", "h:3", info="")  # blind member
+        roll = Collector(store, services=("svc",),
+                         registry_root=ROOT).service_rollup("svc")
+        assert roll["n_teachers"] == 3 and roll["reporting"] == 2
+        assert roll["rows_per_sec"] == 150.0
+        assert roll["util"] == 0.5
+        assert roll["queue_depth"] == 6
+        assert roll["latency_ms_p95"] == 400.0  # the slow member's tail
+
+
+# -- controller: both planes under one election ------------------------------
+
+
+def publish_teacher(registry, service, server, *, p95=40.0, util=0.3,
+                    queue=0, rows=100.0):
+    registry.register_permanent(service, server, info=json.dumps(
+        {"rows_per_sec": rows, "util": util, "queue_depth": queue,
+         "latency_ms_p95": p95, "latency_ms_p50": p95 / 2}))
+
+
+class TestControllerServingPlane:
+    def make_controller(self, store, actuate, **kw):
+        cfg = ServingConfig(slo_p95_ms=250.0, breach_ticks=2,
+                            cooldown_s=5.0, max_teachers=4)
+        kw.setdefault("config", ScalerConfig())
+        return ScalerController(
+            store, [], ThroughputPolicy(), services=["svc"],
+            serving_policy=ServingPolicy(cfg), serving_actuate=actuate,
+            serving_config=cfg, elect=False, scope="svc", **kw), cfg
+
+    def test_breach_grows_through_actuator_and_journals(self):
+        store = InMemStore()
+        registry = ServiceRegistry(store, root=ROOT)
+        publish_teacher(registry, "svc", "h:1", p95=900.0, util=1.0,
+                        queue=20)
+        resizes = []
+        ctl, _ = self.make_controller(
+            store, lambda s, d: resizes.append((s, d))
+            or {"desired_teachers": d})
+        e1 = ctl.tick(now=100.0)
+        assert e1[0]["kind"] == "serving" and e1[0]["action"] == "hold"
+        e2 = ctl.tick(now=101.0)
+        assert e2[0]["action"] == "resize" and e2[0]["applied"] == 2
+        assert resizes == [("svc", 2)]
+        # registry still shows 1 teacher: in-flight until the spawn lands
+        e3 = ctl.tick(now=102.0)
+        assert e3[0]["reason"] == "resize-in-flight"
+        publish_teacher(registry, "svc", "h:2", p95=40.0, util=0.4)
+        e4 = ctl.tick(now=110.0)
+        assert e4[0]["reason"] in ("in-band", "backlog-draining")
+
+    def test_no_actuation_path_journals_error(self):
+        store = InMemStore()
+        registry = ServiceRegistry(store, root=ROOT)
+        publish_teacher(registry, "svc", "h:1", p95=900.0, util=1.0)
+        ctl, _ = self.make_controller(store, None)
+        ctl.tick(now=1.0)
+        (entry,) = ctl.tick(now=2.0)
+        assert entry["action"] == "error"
+        assert "no serving actuation path" in entry["reason"]
+
+    def test_empty_pool_is_not_fresh(self):
+        store = InMemStore()
+        ctl, _ = self.make_controller(store, lambda s, d: {})
+        (entry,) = ctl.tick(now=1.0)
+        assert entry["reason"] == "no-fresh-serving-stats"
+        assert not entry["fresh"]
+
+    def test_takeover_replays_serving_cooldown(self):
+        """A successor controller must not double-resize inside the
+        predecessor's serving cooldown window."""
+        store = InMemStore()
+        registry = ServiceRegistry(store, root=ROOT)
+        publish_teacher(registry, "svc", "h:1", p95=900.0, util=1.0)
+        ctl, _ = self.make_controller(
+            store, lambda s, d: {"desired_teachers": d})
+        ctl.tick(now=100.0)
+        ctl.tick(now=101.0)  # resize journaled at ts=101
+        # successor: same scope, fresh process; registry now shows the
+        # new world so the view itself is actionable again
+        publish_teacher(registry, "svc", "h:2", p95=900.0, util=1.0)
+        succ, _ = self.make_controller(
+            store, lambda s, d: {"desired_teachers": d})
+        (entry,) = succ.tick(now=103.0)
+        assert entry["action"] == "hold" and entry["reason"] == "cooldown"
+
+    def test_trainer_and_serving_side_by_side(self):
+        """One tick, one journal, both planes: trainer jobs keep their
+        entry shape (job_id), pools theirs (kind=serving)."""
+        store = InMemStore()
+        registry = ServiceRegistry(store, root=ROOT)
+        publish_teacher(registry, "svc", "h:1")
+        cfg = ServingConfig(slo_p95_ms=250.0)
+        ctl = ScalerController(
+            store, ["job"], ThroughputPolicy(), services=["svc"],
+            serving_policy=ServingPolicy(cfg),
+            serving_actuate=lambda s, d: {"desired_teachers": d},
+            serving_config=cfg, elect=False, scope="both", dry_run=True)
+        entries = ctl.tick(now=1.0)
+        kinds = [(e.get("job_id"), e.get("kind")) for e in entries]
+        assert kinds == [("job", None), (None, "serving")]
+
+    def test_services_without_serving_policy_requires_mixed(self):
+        with pytest.raises(ValueError):
+            ScalerController(InMemStore(), [], ThroughputPolicy(),
+                             services=["svc"], elect=False)
+        # FairShare exposes decide_mixed: accepted
+        ScalerController(InMemStore(), [], FairSharePolicy(4),
+                         services=["svc"], elect=False, scope="s")
+
+
+# -- fair share across trainers AND pools ------------------------------------
+
+
+class TestFairShareMixed:
+    def test_pool_demand_latency_and_util_bounds(self):
+        pol = FairSharePolicy(8, cooldown_s=15.0, horizon_s=60.0)
+        # latency over target: demand scales n by p95 / (0.75 * slo)
+        v = ServingView("s", 2, util=0.5, latency_ms_p95=600.0,
+                        slo_p95_ms=250.0, max_teachers=8)
+        assert pol.pool_demand(v) == 7  # ceil(2 * 600 / 187.5)
+        # no latency signal: utilization bound keeps rho <= 0.75
+        v = ServingView("s", 4, util=0.9, max_teachers=8)
+        assert pol.pool_demand(v) == 5  # ceil(4 * 0.9 / 0.75)
+        # healthy pool: demand shrinks to the utilization floor
+        # (ceil(4 * 0.2 / 0.75) = 2 — never below what keeps rho sane)
+        v = ServingView("s", 4, util=0.2, latency_ms_p95=30.0,
+                        slo_p95_ms=250.0)
+        assert pol.pool_demand(v) == 2
+        # near-zero traffic: demand collapses to min_teachers
+        v = ServingView("s", 4, util=0.0, latency_ms_p95=None,
+                        slo_p95_ms=250.0)
+        assert pol.pool_demand(v) == 1
+
+    def test_budget_conserved_and_pool_outranks_trainers(self):
+        """A breaching pool is granted its SLO demand FIRST; trainers
+        water-fill the remainder; the joint total never exceeds the
+        budget."""
+        pol = FairSharePolicy(8, cooldown_s=0.0, horizon_s=60.0)
+        for n, rate in ((1, 100.0), (2, 195.0), (3, 285.0)):
+            pol.model("job").observe(n, rate)
+        trainer = JobView("job", 3, 285.0, 1, 8, downtime_s=0.1)
+        pool = ServingView("s", 2, util=1.0, latency_ms_p95=750.0,
+                           slo_p95_ms=250.0, max_teachers=8)
+        t_alloc, p_alloc = pol.plan_mixed([trainer], [pool])
+        assert p_alloc["s"] == pol.pool_demand(pool) == 8
+        assert t_alloc["job"] + p_alloc["s"] <= 8
+        t_props, s_props = pol.decide_mixed([trainer], [pool], now=1.0)
+        total_after = sum(p.desired for p in t_props + s_props)
+        assert total_after <= 8
+        (sp,) = s_props
+        assert sp.desired > sp.current  # the pool got its grow
+
+    def test_mixed_shrink_before_grow_within_budget(self):
+        """The trainer's shrink funds the pool's grow inside one tick's
+        accounting — the transient never exceeds the budget."""
+        pol = FairSharePolicy(6, cooldown_s=0.0, horizon_s=60.0)
+        for n, rate in ((1, 100.0), (4, 110.0)):
+            pol.model("job").observe(n, rate)  # flat: 4 nodes wasted
+        trainer = JobView("job", 4, 110.0, 1, 8, downtime_s=0.1)
+        pool = ServingView("s", 2, util=1.0, latency_ms_p95=500.0,
+                           slo_p95_ms=250.0, max_teachers=8)
+        t_props, s_props = pol.decide_mixed([trainer], [pool], now=1.0)
+        (tp,), (sp,) = t_props, s_props
+        assert tp.desired < tp.current       # trainer shrinks
+        assert sp.desired > sp.current       # pool grows
+        assert tp.desired + sp.desired <= 6  # jointly inside the budget
+
+    def test_mixed_co_simulation_step_shifts_budget(self):
+        """Co-sim: a load step on the pool pulls budget from a flat
+        trainer; the budget is respected on every tick."""
+        from edl_tpu.scaler.simulator import SimCluster, SimJob, flat
+        budget = 6
+        pol = FairSharePolicy(budget, cooldown_s=2.0, horizon_s=60.0,
+                              gain_threshold=0.05)
+        cluster = SimCluster([SimJob("job", flat(100.0), 1, 8, nodes=4,
+                                     noise=0.0)],
+                             tick_s=1.0, downtime_s=0.5, seed=0)
+        pool = SimServingPool("s", step(100.0, 4.0, at=20), teachers=1,
+                              tick_s=1.0, max_teachers=8, seed=0)
+        for _ in range(80):
+            t_views = cluster.tick()
+            s_view = pool.tick()
+            t_props, s_props = pol.decide_mixed(t_views, [s_view],
+                                                cluster.now)
+            for prop in t_props:
+                if prop.is_resize:
+                    actual = cluster.resize(prop.job_id, prop.desired)
+                    pol.notify_resized(prop.job_id, actual, cluster.now)
+            (sp,) = s_props
+            if sp.is_resize:
+                actual = pool.resize(sp.desired)
+                pol.notify_resized("s", actual, cluster.now)
+            live = (cluster.jobs["job"].nodes + pool.ready
+                    + len(pool._pending_spawns))
+            assert live <= budget + 1, f"budget blown: {live}"
+        assert pool.ready >= 2          # the pool grew into the step
+        assert cluster.jobs["job"].nodes < 4  # the flat trainer paid

@@ -1,0 +1,97 @@
+"""Policy x arrival-trace sweep for the serving-elasticity plane.
+
+Runs every (ServingPolicy variant, arrival trace) pair on the
+deterministic `SimServingPool` and prints ONE markdown table: SLO
+attainment %, how many ticks after the load change the SLO was last
+violated (the reaction), resizes spent, the final pool vs the steady
+oracle, and whether the pool stayed put afterwards. Tuning the SLO
+knobs for a deployment is one command: tighten `breach_ticks` until
+the reaction column says stop, then widen `idle_ticks` until
+post-convergence resizes hit 0.
+
+  python tools/serve_bench.py --slo-p95-ms 250 --ticks 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/serve_bench.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
+
+
+def trace_menu(args):
+    from edl_tpu.scaler.simulator import burst, steady, step
+    return ((steady(args.lam * 2), None),
+            (step(args.lam, 4.0, at=40), 40),
+            (step(args.lam, 8.0, at=40), 40),
+            (burst(args.lam, 4.0, at=40, length=25), 40))
+
+
+def policy_menu(args):
+    from edl_tpu.scaler.serving import ServingConfig, ServingPolicy
+
+    def make(name, **kw):
+        base = dict(slo_p95_ms=args.slo_p95_ms, breach_ticks=2,
+                    idle_ticks=5, cooldown_s=args.cooldown,
+                    max_teachers=args.max_teachers)
+        base.update(kw)
+        return name, lambda: ServingPolicy(ServingConfig(**base))
+
+    return (make("default"),
+            make("aggressive", breach_ticks=1, cooldown_s=5.0,
+                 grow_max_factor=4.0),
+            make("conservative", breach_ticks=4, idle_ticks=10,
+                 cooldown_s=30.0))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tools/serve_bench.py")
+    parser.add_argument("--ticks", type=int, default=200)
+    parser.add_argument("--tick-s", type=float, default=1.0)
+    parser.add_argument("--lam", type=float, default=100.0,
+                        help="base arrival rate rows/sec")
+    parser.add_argument("--teacher-rate", type=float, default=250.0,
+                        help="one teacher's service rate rows/sec")
+    parser.add_argument("--slo-p95-ms", type=float, default=250.0)
+    parser.add_argument("--cooldown", type=float, default=15.0)
+    parser.add_argument("--noise", type=float, default=0.01)
+    parser.add_argument("--max-teachers", type=int, default=16)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    from edl_tpu.scaler.simulator import SimServingPool, run_serving_policy
+
+    print(f"ticks={args.ticks} tick={args.tick_s:g}s "
+          f"slo={args.slo_p95_ms:g}ms teacher_rate={args.teacher_rate:g} "
+          f"rows/s noise={args.noise} (react = ticks from the load "
+          f"change to the LAST SLO violation; post = resizes in the "
+          f"trailing 50-tick window, the oscillation alarm)")
+    print("| policy | trace | attain % | react (ticks) | resizes "
+          "| final | oracle | post |")
+    print("|---|---|---|---|---|---|---|---|")
+    for policy_name, make_policy in policy_menu(args):
+        for trace, change_at in trace_menu(args):
+            pool = SimServingPool(
+                "svc", trace, teacher_rate=args.teacher_rate,
+                slo_p95_ms=args.slo_p95_ms, teachers=1,
+                max_teachers=args.max_teachers, tick_s=args.tick_s,
+                noise=args.noise, seed=args.seed)
+            out = run_serving_policy(pool, make_policy(),
+                                     ticks=args.ticks, settle_ticks=50)
+            react = (max(0, out["last_violation_tick"] - change_at)
+                     if change_at is not None
+                     else out["last_violation_tick"])
+            oracle = pool.oracle_teachers(trace(args.ticks))
+            print(f"| {policy_name} | {out['trace']} "
+                  f"| {100 * out['slo_attainment']:.1f} | {react} "
+                  f"| {out['resizes']} | {out['final_teachers']} "
+                  f"| {oracle} | {out['post_convergence_resizes']} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
