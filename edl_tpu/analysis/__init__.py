@@ -1,0 +1,47 @@
+"""edl-lint — the invariant-enforcing analysis plane.
+
+The reference EDL rotted into unimportable skeleton code: ``NameError``s
+at import time, undefined classes, contracts that lived only in design
+docs (SURVEY.md, "working vs. skeleton code").  This package is the
+countermeasure: every contract the docs state is encoded as a machine
+check and wired as a CI gate, so drift fails the build instead of
+accumulating.
+
+Two planes:
+
+- **edl-lint** (``python -m edl_tpu.analysis lint``) — a stdlib-only AST
+  framework (`core.py`) with five checkers (`checks/`):
+
+  * ``layering``            — the declared layer map (`layers.toml`):
+    coord/scaler/analysis never import jax/numpy/train, data never
+    imports distill; violations name the full import chain.
+  * ``env-registry``        — every ``EDL_TPU_*`` env read goes through
+    the central declaration table in `utils/config.py` AND has a row in
+    the ``doc/usage.md`` reference table (flags undocumented knobs and
+    dead doc rows both ways).
+  * ``guarded-by``          — fields annotated ``# guarded-by: _lock``
+    are only mutated under ``with self._lock``.
+  * ``resource-lifecycle``  — classes that create threads / shared
+    memory / sockets define a teardown method, and instantiation sites
+    are context-managed, finally-closed, or registered long-lived.
+  * ``sim-determinism``     — wall clocks and unseeded RNGs are banned
+    from the scaler simulator and everything it imports (the
+    seeded-exact bench contract, made structural).
+
+  Inline suppressions: ``# edl-lint: disable=<check>(<reason>)`` — the
+  reason is mandatory, unused suppressions are themselves findings.
+
+- **lockgraph** (`lockgraph.py`) — a ``threading`` instrumentation
+  harness + pytest plugin (``EDL_TPU_LOCKGRAPH=1``) that records
+  per-thread lock-acquisition orderings during the test run, builds the
+  global lock-order graph, and fails on cycles (potential ABBA
+  deadlock) with both acquisition stacks printed.
+
+This package is pure stdlib — importable (and runnable in CI) without
+jax, numpy, or the accelerator stack; ``tests/test_analysis.py`` pins
+that.
+"""
+
+from edl_tpu.analysis.core import Finding, LintResult, Project, run_lint
+
+__all__ = ["Finding", "LintResult", "Project", "run_lint"]

@@ -1,0 +1,693 @@
+"""lockgraph — a dynamic lock-order race detector for the control plane.
+
+Ten subsystems of this codebase run hand-rolled threads (async ckpt
+writer, mp loader, watch streams, teacher batcher, drain actuator, ...)
+whose lock discipline was, until this module, checked by nothing but
+review.  TSAN covers only ``native/store``.  lockgraph closes the gap
+for the Python planes the way lockdep does for the kernel: **record the
+order in which every thread nests lock acquisitions, build the global
+lock-order graph, and fail on cycles** — a cycle is a potential ABBA
+deadlock even if this particular run interleaved safely.
+
+How it instruments (``install()``):
+
+- ``threading.Lock`` / ``threading.RLock`` factories are replaced; a
+  lock **created from edl code** (creation site resolved by walking out
+  of threading/queue/this module) is wrapped in a tracking proxy.
+  Locks created by third-party/stdlib internals stay native, which
+  bounds overhead and noise.  ``threading.Condition()`` and
+  ``threading.Event()`` create their inner lock through the patched
+  factory, so condition waits release/reacquire through the proxy and
+  the held-set stays truthful across ``wait()``.
+- ``queue.Queue`` is replaced by a subclass that models the blocking
+  hand-off as pseudo-resources: a bounded ``put`` **waits for**
+  ``space:Q`` (edge ``held-lock -> space:Q``), a ``get`` under a lock
+  **frees** it (edge ``space:Q -> that lock``); symmetrically for
+  ``items:Q`` on the get side.  A cycle through a pseudo-node is a
+  lock-held-across-blocking-queue-op deadlock — the classic
+  "``put`` to a bounded queue while holding the lock its consumer
+  needs" hazard that a pure lock graph cannot see.  A blocking bounded
+  ``put`` from a thread that is itself a recorded consumer of the same
+  queue is flagged immediately (``put-to-self``: nobody else will ever
+  drain it once it fills).
+
+Lock identity is the **creation site** (file:line), lockdep-style: all
+instances born at one site share a node, so per-connection locks
+aggregate instead of exploding the graph.  The cost of that choice:
+two instances from the same site nested inside each other form a
+self-edge, which is reported as a warning, not a failure (instances may
+be globally ordered in a way site-granularity cannot prove).
+
+What it cannot see (documented, deliberate): ``multiprocessing``
+queues (cross-process), ``queue.SimpleQueue`` (C implementation),
+condition-variable wait-for-state cycles that involve no lock or
+bounded queue, and locks created before ``install()`` ran — the pytest
+plugin (``EDL_TPU_LOCKGRAPH=1`` in ``tests/conftest.py``) installs at
+conftest import, before any edl_tpu module is imported.
+
+Run ``python -m edl_tpu.analysis lockgraph-selftest`` for the seeded
+proofs, or ``EDL_TPU_LOCKGRAPH=1 python -m pytest tests/`` for a full
+audit (report written to ``EDL_TPU_LOCKGRAPH_OUT`` or
+``/tmp/edl_lockgraph.json``; the session FAILS on any cycle).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue as queue_mod
+import sys
+import threading
+import traceback
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+_ORIG_QUEUE = queue_mod.Queue
+
+_SKIP_FILES = (os.sep + "threading.py", os.sep + "queue.py")
+
+# code objects of the instrumentation itself (proxies, factories, the
+# recorder) — skipped by frame identity, NOT by filename, so locks
+# created by code that happens to live in this file (the selftest
+# scenarios) still resolve to their true creation site
+_INSTR_CODES: set = set()
+
+
+def _creation_site(extra_skip: int = 0) -> tuple[str, int]:
+    """(file, line) of the first frame outside the instrumentation and
+    outside threading/queue — the lock's OWNER in user code."""
+    frame = sys._getframe(1 + extra_skip)
+    while frame is not None:
+        code = frame.f_code
+        if code not in _INSTR_CODES \
+                and not code.co_filename.endswith(_SKIP_FILES):
+            return code.co_filename, frame.f_lineno
+        frame = frame.f_back
+    return "<unknown>", 0
+
+
+def _site_key(site: tuple[str, int]) -> str:
+    fn, line = site
+    # repo-relative when possible: stable across hosts, readable reports
+    for marker in ("edl_tpu" + os.sep, "tests" + os.sep):
+        idx = fn.rfind(marker)
+        if idx >= 0:
+            fn = fn[idx:]
+            break
+    return f"{fn.replace(os.sep, '/')}:{line}"
+
+
+class LockGraph:
+    """The recorder: per-thread held sets, first-seen edges w/ stacks."""
+
+    def __init__(self):
+        self._mu = _ORIG_LOCK()
+        self.active = True
+        # tid -> list[[site, lock_id, count, acquire_site]]
+        self._held: dict[int, list[list]] = {}
+        # (from_site, to_site) -> {"count", "stack_held", "stack_acq"}
+        self.edges: dict[tuple[str, str], dict] = {}
+        self.hazards: list[dict] = []
+        self._hazard_seen: set[tuple] = set()
+        self.lock_sites: set[str] = set()
+
+    # -- held-set bookkeeping (all under _mu) -------------------------------
+
+    def _entries(self) -> list[list]:
+        tid = threading.get_ident()
+        return self._held.setdefault(tid, [])
+
+    def note_waiting(self, site: str, lock_id: int) -> None:
+        """A blocking acquire is about to start: record ordering edges
+        from every lock this thread already holds.  Re-acquiring the
+        SAME instance (RLock re-entry) is not an ordering edge; a
+        distinct instance from the same creation site IS — it surfaces
+        as a self-edge warning in the report."""
+        if not self.active:
+            return
+        caller = _site_key(_creation_site(1))
+        with self._mu:
+            for entry in self._entries():
+                if entry[1] != lock_id:
+                    self._edge(entry[0], site, entry[3], caller)
+
+    def note_acquired(self, site: str, lock_id: int) -> None:
+        if not self.active:
+            return
+        caller = _site_key(_creation_site(1))
+        with self._mu:
+            entries = self._entries()
+            for entry in entries:
+                if entry[1] == lock_id:
+                    entry[2] += 1
+                    return
+            entries.append([site, lock_id, 1, caller])
+
+    def note_released(self, site: str, lock_id: int,
+                      count: int = 1) -> None:
+        del site
+        if not self.active:
+            return
+        with self._mu:
+            # the releasing thread may differ from the acquirer
+            # (hand-off locks): search every thread's held list
+            for entries in self._held.values():
+                for i, entry in enumerate(entries):
+                    if entry[1] == lock_id:
+                        entry[2] -= count
+                        if entry[2] <= 0:
+                            del entries[i]
+                        return
+
+    def held_count(self, lock_id: int) -> int:
+        with self._mu:
+            for entries in self._held.values():
+                for entry in entries:
+                    if entry[1] == lock_id:
+                        return entry[2]
+        return 0
+
+    def _edge(self, a: str, b: str, stack_held: str, stack_acq: str) -> None:
+        # caller holds _mu
+        key = (a, b)
+        rec = self.edges.get(key)
+        if rec is None:
+            try:
+                frame = sys._getframe(3)
+            except ValueError:  # pragma: no cover - shallow stack
+                frame = None
+            self.edges[key] = {"count": 1, "held_at": stack_held,
+                               "acquired_at": stack_acq,
+                               "stack": "".join(traceback.format_stack(
+                                   frame, limit=12))}
+        else:
+            rec["count"] += 1
+
+    # -- queue modeling -----------------------------------------------------
+
+    def note_queue_put(self, qsite: str, bounded: bool, block: bool,
+                       self_put: bool = False) -> None:
+        """`self_put` is computed by the queue INSTANCE (the putting
+        thread previously got from this very queue object) — site-level
+        consumer tracking would alias every per-connection queue born on
+        one line and convict on OS thread-id reuse across instances."""
+        if not self.active:
+            return
+        caller = _site_key(_creation_site(1))
+        with self._mu:
+            held = [e for e in self._entries()]
+            if bounded and block:
+                if self_put:
+                    key = ("put-to-self", qsite, caller)
+                    if key not in self._hazard_seen:
+                        self._hazard_seen.add(key)
+                        self.hazards.append({
+                            "kind": "put-to-self",
+                            "queue": qsite, "at": caller,
+                            "detail": "blocking put on a bounded queue "
+                                      "from a thread that also consumes "
+                                      "it — self-deadlock once the queue "
+                                      "fills",
+                            "stack": "".join(traceback.format_stack(
+                                sys._getframe(2), limit=12))})
+                for entry in held:
+                    self._edge(entry[0], f"space:{qsite}", entry[3], caller)
+            # producing items while holding these locks: draining the
+            # queue transitively depends on them
+            for entry in held:
+                self._edge(f"items:{qsite}", entry[0], caller, entry[3])
+
+    def note_queue_get(self, qsite: str, block: bool) -> None:
+        if not self.active:
+            return
+        caller = _site_key(_creation_site(1))
+        with self._mu:
+            held = [e for e in self._entries()]
+            if block:
+                for entry in held:
+                    self._edge(entry[0], f"items:{qsite}", entry[3], caller)
+            # freeing space while holding these locks
+            for entry in held:
+                self._edge(f"space:{qsite}", entry[0], caller, entry[3])
+
+    # -- analysis -----------------------------------------------------------
+
+    def cycles(self) -> list[list[str]]:
+        """Strongly connected components of size >= 2 (Tarjan,
+        iterative).  Self-edges are excluded here and reported as
+        warnings by ``report()``."""
+        graph: dict[str, list[str]] = {}
+        for (a, b) in self.edges:
+            if a != b:
+                graph.setdefault(a, []).append(b)
+                graph.setdefault(b, [])
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set[str] = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        for root in sorted(graph):
+            if root in index:
+                continue
+            work = [(root, iter(graph[root]))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append((nxt, iter(graph[nxt])))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        member = stack.pop()
+                        on_stack.discard(member)
+                        scc.append(member)
+                        if member == node:
+                            break
+                    if len(scc) >= 2:
+                        sccs.append(sorted(scc))
+        return sccs
+
+    def report(self) -> dict:
+        cycles = self.cycles()
+        cycle_edges = []
+        for scc in cycles:
+            members = set(scc)
+            for (a, b), rec in sorted(self.edges.items()):
+                if a in members and b in members:
+                    cycle_edges.append({
+                        "from": a, "to": b, "count": rec["count"],
+                        "held_at": rec["held_at"],
+                        "acquired_at": rec["acquired_at"],
+                        "stack": rec["stack"]})
+        self_edges = [{"site": a, "count": rec["count"],
+                       "stack": rec["stack"]}
+                      for (a, b), rec in sorted(self.edges.items())
+                      if a == b]
+        return {
+            "locks_tracked": len(self.lock_sites),
+            "edges": len(self.edges),
+            "cycles": cycles,
+            "cycle_edges": cycle_edges,
+            "hazards": self.hazards,
+            "self_edge_warnings": self_edges,
+            "ok": not cycles and not self.hazards,
+        }
+
+
+# --------------------------------------------------------------------------
+# proxies
+
+
+class _PlainTrackedLock:
+    """Proxy around a plain ``Lock``; same blocking semantics, every
+    blocking acquire recorded against the holder's held-set.
+
+    Deliberately does NOT define ``_release_save``/``_acquire_restore``:
+    ``threading.Condition`` probes for them and, absent, falls back to
+    ``acquire``/``release`` — the tracked proxy methods — so condition
+    waits keep the held-set truthful."""
+
+    __slots__ = ("_inner", "_site", "_graph")
+
+    def __init__(self, inner, site: str, graph: LockGraph):
+        self._inner = inner
+        self._site = site
+        self._graph = graph
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            self._graph.note_waiting(self._site, id(self))
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._graph.note_acquired(self._site, id(self))
+        return got
+
+    def release(self) -> None:
+        self._graph.note_released(self._site, id(self))
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<tracked {self._inner!r} @ {self._site}>"
+
+    def _is_owned(self):
+        # plain-Lock probe (mirrors threading.Condition's fallback)
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+
+
+class _TrackedRLock(_PlainTrackedLock):
+    """RLock flavor: Condition.wait() releases ALL recursion levels via
+    ``_release_save`` — route it through the proxy so the held-set
+    reflects the park (and the re-acquire records ordering edges)."""
+
+    __slots__ = ()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        count = state[0] if isinstance(state, tuple) else 1
+        self._graph.note_released(self._site, id(self), count=count)
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        self._graph.note_waiting(self._site, id(self))
+        self._inner._acquire_restore(state)
+        count = state[0] if isinstance(state, tuple) else 1
+        for _ in range(count):
+            self._graph.note_acquired(self._site, id(self))
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+class _Installer:
+    def __init__(self, graph: LockGraph, wrap_all: bool,
+                 markers: tuple[str, ...]):
+        self.graph = graph
+        self.wrap_all = wrap_all
+        self.markers = markers
+
+    def _should_wrap(self, site_file: str) -> bool:
+        if self.wrap_all:
+            return True
+        return any(m in site_file for m in self.markers)
+
+    def make_lock(self):
+        site = _creation_site(1)
+        if not self._should_wrap(site[0]):
+            return _ORIG_LOCK()
+        key = _site_key(site)
+        self.graph.lock_sites.add(key)
+        return _PlainTrackedLock(_ORIG_LOCK(), key, self.graph)
+
+    def make_rlock(self):
+        site = _creation_site(1)
+        if not self._should_wrap(site[0]):
+            return _ORIG_RLOCK()
+        key = _site_key(site)
+        self.graph.lock_sites.add(key)
+        return _TrackedRLock(_ORIG_RLOCK(), key, self.graph)
+
+    def make_queue_class(self):
+        installer = self
+
+        class TrackedQueue(_ORIG_QUEUE):
+            def __init__(self, maxsize: int = 0):
+                site = _creation_site(1)
+                self._lg_site = (_site_key(site)
+                                 if installer._should_wrap(site[0])
+                                 else None)
+                # tids that have EVER gotten from THIS instance —
+                # per-instance on purpose (site-level tracking aliases
+                # per-connection queues and convicts on tid reuse)
+                self._lg_getters: set[int] = set()
+                super().__init__(maxsize)
+
+            def put(self, item, block: bool = True,
+                    timeout: float | None = None):
+                if self._lg_site is not None:
+                    installer.graph.note_queue_put(
+                        self._lg_site, bounded=self.maxsize > 0,
+                        block=block,
+                        self_put=threading.get_ident()
+                        in self._lg_getters)
+                return super().put(item, block, timeout)
+
+            def get(self, block: bool = True,
+                    timeout: float | None = None):
+                if self._lg_site is not None:
+                    self._lg_getters.add(threading.get_ident())
+                    installer.graph.note_queue_get(self._lg_site,
+                                                   block=block)
+                return super().get(block, timeout)
+
+        _INSTR_CODES.update({TrackedQueue.__init__.__code__,
+                             TrackedQueue.put.__code__,
+                             TrackedQueue.get.__code__})
+        return TrackedQueue
+
+
+_INSTR_CODES.update(
+    fn.__code__ for fn in (
+        LockGraph.note_waiting, LockGraph.note_acquired,
+        LockGraph.note_released, LockGraph.note_queue_put,
+        LockGraph.note_queue_get, LockGraph._edge,
+        _PlainTrackedLock.acquire, _PlainTrackedLock.release,
+        _PlainTrackedLock.__enter__, _PlainTrackedLock.__exit__,
+        _PlainTrackedLock._is_owned,
+        _TrackedRLock._release_save, _TrackedRLock._acquire_restore,
+        _Installer.make_lock, _Installer.make_rlock,
+    ))
+
+# Installers form a STACK: a scoped install (the selftest, unit tests)
+# over a session-wide one (the pytest plugin) must record into its OWN
+# fresh graph — a seeded ABBA scenario polluting the session graph
+# would fail the whole run — and popping it must RESUME the outer
+# instrumentation, not strip it. Locks already wrapped keep recording
+# into the graph they were born under either way.
+_STACK: list[_Installer] = []
+
+
+def _apply(installer: _Installer | None) -> None:
+    if installer is None:
+        threading.Lock = _ORIG_LOCK               # type: ignore[misc]
+        threading.RLock = _ORIG_RLOCK             # type: ignore[misc]
+        queue_mod.Queue = _ORIG_QUEUE             # type: ignore[misc]
+    else:
+        threading.Lock = installer.make_lock      # type: ignore[misc]
+        threading.RLock = installer.make_rlock    # type: ignore[misc]
+        queue_mod.Queue = installer.queue_class   # type: ignore[misc]
+
+
+def install(wrap_all: bool = False,
+            markers: tuple[str, ...] = ("edl_tpu", "tests")
+            ) -> LockGraph:
+    """Patch the factories; locks/queues created FROM NOW ON in files
+    matching `markers` are tracked.  Returns a FRESH graph (nesting
+    allowed — see the stack note above).  Call as early as possible
+    (before edl_tpu imports) so module-level locks are caught."""
+    installer = _Installer(LockGraph(), wrap_all, markers)
+    installer.queue_class = installer.make_queue_class()
+    _STACK.append(installer)
+    _apply(installer)
+    return installer.graph
+
+
+def uninstall() -> None:
+    """Pop the innermost install: its graph stops recording and the
+    previous instrumentation (or the original factories) resumes."""
+    if not _STACK:
+        return
+    top = _STACK.pop()
+    top.graph.active = False
+    _apply(_STACK[-1] if _STACK else None)
+
+
+def plugin_enabled() -> bool:
+    """The EDL_TPU_LOCKGRAPH=1 contract consumed by tests/conftest.py."""
+    from edl_tpu.utils import config as _cfg
+    return _cfg.env_flag("EDL_TPU_LOCKGRAPH", False)
+
+
+def default_report_path() -> str:
+    from edl_tpu.utils import config as _cfg
+    return _cfg.env_str("EDL_TPU_LOCKGRAPH_OUT",
+                        "/tmp/edl_lockgraph.json") or \
+        "/tmp/edl_lockgraph.json"
+
+
+def write_report(graph: LockGraph, path: str) -> dict:
+    rep = graph.report()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(rep, f, indent=2, sort_keys=True)
+    return rep
+
+
+def render_failure(rep: dict) -> str:
+    lines = ["lockgraph: lock-order violations detected", ""]
+    for cyc in rep["cycles"]:
+        lines.append("  cycle: " + " -> ".join(cyc + [cyc[0]]))
+    for edge in rep["cycle_edges"]:
+        lines.append(f"\n  edge {edge['from']} -> {edge['to']} "
+                     f"(seen {edge['count']}x)")
+        lines.append(f"    holder acquired at {edge['held_at']}, "
+                     f"then acquired {edge['to']} at "
+                     f"{edge['acquired_at']}")
+        lines.append("    first-seen stack:\n" + "\n".join(
+            "      " + ln for ln in edge["stack"].splitlines()))
+    for hz in rep["hazards"]:
+        lines.append(f"\n  hazard [{hz['kind']}] on {hz['queue']} at "
+                     f"{hz['at']}: {hz['detail']}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# selftest
+
+
+def selftest(verbose: bool = True) -> int:
+    """Three seeded scenarios prove the detector's teeth:
+
+    1. an ABBA pair (two threads, opposite nesting) -> cycle;
+    2. a lock held across a blocking ``put`` to a bounded queue whose
+       consumer takes the same lock -> cycle through the pseudo-node,
+       plus the put-to-self direct hazard on a second queue;
+    3. a well-ordered control (consistent nesting, lock-free queue
+       hand-off) -> clean graph.
+
+    The scenarios run the threads SEQUENTIALLY — the whole point of a
+    lock-order graph is that it convicts on ordering evidence without
+    needing the unlucky interleaving to actually happen.
+    """
+    failures: list[str] = []
+
+    # 1: ABBA --------------------------------------------------------------
+    graph = install(wrap_all=True)
+    try:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def t1():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def t2():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        for fn in (t1, t2):
+            th = threading.Thread(target=fn)
+            th.start()
+            th.join()
+        rep = graph.report()
+        if not rep["cycles"]:
+            failures.append("ABBA cycle NOT detected")
+        elif verbose:
+            print("selftest 1 OK: ABBA cycle detected:",
+                  rep["cycles"][0])
+    finally:
+        uninstall()
+
+    # 2: lock held across bounded put-to-self / put-vs-consumer ------------
+    graph = install(wrap_all=True)
+    try:
+        lock = threading.Lock()
+        q = queue_mod.Queue(maxsize=1)
+
+        def consumer():
+            with lock:          # consumer needs `lock` to drain
+                q.get()
+
+        def producer():
+            with lock:          # ...which the producer holds across put
+                q.put("x")
+
+        pth = threading.Thread(target=producer)
+        pth.start()
+        pth.join()
+        cth = threading.Thread(target=consumer)
+        cth.start()
+        cth.join()
+
+        # and the direct self-hazard: one thread both gets and
+        # block-puts on the same bounded queue
+        q2 = queue_mod.Queue(maxsize=4)
+        q2.put("seed")
+        q2.get()
+        q2.put("again")
+
+        rep = graph.report()
+        pseudo_cycle = any(
+            any(node.startswith(("space:", "items:")) for node in cyc)
+            for cyc in rep["cycles"])
+        if not pseudo_cycle:
+            failures.append(
+                "lock-held-across-queue.put cycle NOT detected")
+        elif verbose:
+            print("selftest 2 OK: queue hand-off cycle detected:",
+                  [c for c in rep["cycles"]
+                   if any(n.startswith(("space:", "items:"))
+                          for n in c)][0])
+        if not any(h["kind"] == "put-to-self" for h in rep["hazards"]):
+            failures.append("put-to-self hazard NOT detected")
+        elif verbose:
+            print("selftest 2 OK: put-to-self hazard flagged")
+    finally:
+        uninstall()
+
+    # 3: clean control ------------------------------------------------------
+    graph = install(wrap_all=True)
+    try:
+        outer = threading.Lock()
+        inner = threading.Lock()
+        q = queue_mod.Queue()   # unbounded: put never blocks
+
+        def worker():
+            with outer:
+                with inner:
+                    q.put("x")
+            q.get()
+
+        for _ in range(2):
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+        rep = graph.report()
+        if rep["cycles"] or rep["hazards"]:
+            failures.append(
+                f"clean scenario convicted: cycles={rep['cycles']} "
+                f"hazards={rep['hazards']}")
+        elif verbose:
+            print("selftest 3 OK: well-ordered scenario stays clean "
+                  f"({rep['edges']} edges recorded)")
+    finally:
+        uninstall()
+
+    if failures:
+        for f in failures:
+            print("lockgraph selftest FAILED:", f, file=sys.stderr)
+        return 1
+    if verbose:
+        print("lockgraph selftest: all scenarios passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(selftest())

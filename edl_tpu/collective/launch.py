@@ -53,7 +53,9 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
            n_devices: int | None = None,
            healthy_generation_secs: float = 60.0) -> int:
     """Run the elastic loop until the job completes. Returns exit code."""
-    store = store or StoreClient(job.store_endpoints)
+    owns_store = store is None
+    if store is None:
+        store = StoreClient(job.store_endpoints)  # closed in the finally
     if n_devices is None:
         n_devices = max(1, job.nproc_per_node)
     # The coordinator port is stable across membership restarts (published
@@ -234,6 +236,11 @@ def launch(job: JobEnv, trainer_cmd: list[str], *, store: Store | None = None,
             else:
                 terminate_trainer(trainer)
         register.release()
+        if owns_store:
+            try:
+                store.close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
     return 0
 
 

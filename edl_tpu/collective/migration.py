@@ -9,7 +9,7 @@ the safety net:
   newest SEALED checkpoint snapshot (the async-checkpoint plane's
   retained host-side copy — no extra device->host transfer) is served
   chunk-by-chunk over the zero-copy binary tensor wire
-  (distill/tensor_wire.py gather-send);
+  (data/tensor_wire.py gather-send);
 - a (re)starting trainer **restores from peers**: donor manifests are
   merged into the same self-describing chunk index the on-disk sharded
   format uses, and the cross-mesh resharding planner
@@ -45,7 +45,6 @@ Store key layout (all under the job scope):
 from __future__ import annotations
 
 import json
-import os
 import socket
 import threading
 import time
@@ -54,7 +53,8 @@ from typing import Any, Callable
 import numpy as np
 
 from edl_tpu.coord.store import Store
-from edl_tpu.distill.tensor_wire import (TensorWireError, recv_tensors,
+from edl_tpu.utils import config
+from edl_tpu.data.tensor_wire import (TensorWireError, recv_tensors,
                                          send_tensors)
 from edl_tpu.utils.exceptions import EdlError
 from edl_tpu.utils.logging import get_logger
@@ -89,8 +89,9 @@ def ack_key(job_id: str, pod_id: str) -> str:
 
 
 def p2p_enabled(environ=None) -> bool:
-    env = os.environ if environ is None else environ
-    return env.get("EDL_TPU_RESIZE_P2P", "1") != "0"
+    if environ is None:
+        return config.env_flag("EDL_TPU_RESIZE_P2P", True)
+    return environ.get("EDL_TPU_RESIZE_P2P", "1") != "0"
 
 
 def live_donors(store: Store, job_id: str) -> list[dict]:
@@ -129,9 +130,9 @@ class MigrationServer:
         self._sock.listen(16)
         self.port = self._sock.getsockname()[1]
         self._lock = threading.Lock()
-        self._snap: dict | None = None
+        self._snap: dict | None = None            # guarded-by: _lock
         self._stop = threading.Event()
-        self._conns: set[socket.socket] = set()
+        self._conns: set[socket.socket] = set()   # guarded-by: _lock
         self._accept = threading.Thread(target=self._accept_loop,
                                         daemon=True, name="edl-migrate-srv")
         self._accept.start()
@@ -439,12 +440,12 @@ class MigrationService:
         self._lease: int | None = None
         self._keeper = None
         self._advert_dirty = threading.Event()
-        self._advert_doc: dict | None = None
+        self._advert_doc: dict | None = None  # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._advert_thread: threading.Thread | None = None
         # reform watch
-        self._reform: Reform | None = None
+        self._reform: Reform | None = None    # guarded-by: _lock
         self._watch_thread: threading.Thread | None = None
         self._reform_watch = None
         self._ckpt = None
@@ -457,11 +458,11 @@ class MigrationService:
         disabled, the trainer runs standalone, or the store is down."""
         if not p2p_enabled():
             return None
-        if "EDL_TPU_RANK" not in os.environ:
+        if not config.env_present("EDL_TPU_RANK"):
             return None  # not under the elastic launcher
-        endpoints = os.environ.get("EDL_TPU_STORE_ENDPOINTS", "")
-        job_id = os.environ.get("EDL_TPU_JOB_ID", "")
-        pod_id = os.environ.get("EDL_TPU_POD_ID", "")
+        endpoints = config.env_str("EDL_TPU_STORE_ENDPOINTS", "") or ""
+        job_id = config.env_str("EDL_TPU_JOB_ID", "") or ""
+        pod_id = config.env_str("EDL_TPU_POD_ID", "") or ""
         if not (endpoints and job_id and pod_id):
             return None
         from edl_tpu.coord.redis_store import connect_store
@@ -471,11 +472,9 @@ class MigrationService:
             log.warning("migration service disabled (store unreachable: "
                         "%s)", exc)
             return None
-        linger = os.environ.get("EDL_TPU_DONOR_LINGER", "").strip()
         svc = cls(store, job_id, pod_id,
-                  generation=int(os.environ.get(
-                      "EDL_TPU_CLUSTER_VERSION", "0") or 0),
-                  linger_s=float(linger) if linger else 10.0,
+                  generation=config.env_int("EDL_TPU_CLUSTER_VERSION", 0),
+                  linger_s=config.env_float("EDL_TPU_DONOR_LINGER", 10.0),
                   owns_store=True)
         if ckpt is not None:
             svc.attach(ckpt)

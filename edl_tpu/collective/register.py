@@ -121,3 +121,8 @@ class PodRegister:
             self._keeper.stop(revoke=True)
             self._keeper = None
             self.lease = None
+
+    def close(self) -> None:
+        """Teardown alias for `release` (edl-lint resource-lifecycle:
+        the keeper thread's joining close path)."""
+        self.release()

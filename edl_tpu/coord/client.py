@@ -189,9 +189,9 @@ class ClientWatch(Watch):
         self._last_rev = start_revision  # None until the first ack
         self.created_revision = start_revision or 0
         self._cond = threading.Condition()
-        self._queue: deque[WatchBatch] = deque()
+        self._queue: deque[WatchBatch] = deque()  # guarded-by: _cond
         self._stop = threading.Event()
-        self._sock: socket.socket | None = None
+        self._sock: socket.socket | None = None   # guarded-by: _cond
         self._ready = threading.Event()   # first ack received
         self._rejected: str | None = None  # server refused the op
         self._thread = threading.Thread(

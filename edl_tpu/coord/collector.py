@@ -98,7 +98,7 @@ class UtilizationPublisher:
         self.world_size = world_size
         # `published_unix` must be monotonic per pod even across clock
         # hiccups: the scaler's staleness check subtracts it from now()
-        self._pub_unix = 0.0
+        self._pub_unix = 0.0             # guarded-by: _lock
         self._lease: int | None = None
         self._keeper = None
         self._lock = threading.Lock()
@@ -106,17 +106,19 @@ class UtilizationPublisher:
         # _pending reaches zero (the bench host has ONE core — a 10 ms
         # sleep-poll loop here measurably stole it from training)
         self._drained = threading.Condition(self._lock)
-        self._last_pub = 0.0
+        self._last_pub = 0.0             # guarded-by: _lock
         # rate window seeds on the FIRST call: samples_seen may restore
         # non-zero from a checkpoint, and measuring from 0 would report
         # a wildly inflated examples_per_sec right after every resize
-        self._last_samples: int | None = None
-        self._last_t = time.monotonic()
+        self._last_samples: int | None = None  # guarded-by: _lock
+        self._last_t = time.monotonic()  # guarded-by: _lock
+        # publisher-thread-only until stop() joins it (happens-before)
         self._cooldown_until = 0.0
         self._owns_store = False  # from_env's connection: close on stop
         # latest-wins mailbox + lazily-started publisher thread
         self._mailbox: "queue.Queue[dict]" = queue.Queue(maxsize=1)
-        self._pending = 0                # snapshots enqueued, unpublished
+        # snapshots enqueued, unpublished
+        self._pending = 0                # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -124,14 +126,14 @@ class UtilizationPublisher:
     def from_env(cls) -> "UtilizationPublisher | None":
         """Build from the launcher's trainer env (TRAINER_ENV_VARS);
         None when not under the elastic launcher or opted out."""
-        import os
-        if os.environ.get("EDL_TPU_PUBLISH_UTIL", "1") == "0":
+        from edl_tpu.utils import config
+        if not config.env_flag("EDL_TPU_PUBLISH_UTIL", True):
             return None
-        if "EDL_TPU_RANK" not in os.environ:
+        if not config.env_present("EDL_TPU_RANK"):
             return None  # standalone run: nothing to publish into
-        endpoints = os.environ.get("EDL_TPU_STORE_ENDPOINTS", "")
-        job_id = os.environ.get("EDL_TPU_JOB_ID", "")
-        pod_id = os.environ.get("EDL_TPU_POD_ID", "")
+        endpoints = config.env_str("EDL_TPU_STORE_ENDPOINTS", "") or ""
+        job_id = config.env_str("EDL_TPU_JOB_ID", "") or ""
+        pod_id = config.env_str("EDL_TPU_POD_ID", "") or ""
         if not (endpoints and job_id and pod_id):
             return None
         from edl_tpu.coord.redis_store import connect_store
@@ -141,12 +143,12 @@ class UtilizationPublisher:
             log.warning("utilization publisher disabled (store "
                         "unreachable: %s)", exc)
             return None
-        world = os.environ.get("EDL_TPU_WORLD_SIZE", "")
+        world = config.env_int("EDL_TPU_WORLD_SIZE", 0)
         pub = cls(store, job_id, pod_id,
-                  rank=int(os.environ.get("EDL_TPU_RANK", "-1")),
-                  generation=int(os.environ.get(
-                      "EDL_TPU_CLUSTER_VERSION", "0")) or None,
-                  world_size=int(world) if world else None)
+                  rank=config.env_int("EDL_TPU_RANK", -1),
+                  generation=config.env_int("EDL_TPU_CLUSTER_VERSION",
+                                            0) or None,
+                  world_size=world or None)
         pub._owns_store = True
         return pub
 

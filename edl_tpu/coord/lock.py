@@ -13,6 +13,10 @@ an `is_leader()` check callers must consult before privileged writes
 (the fencing discipline: leadership is a lease-backed hint, so the
 holder re-validates, exactly like the reference master re-checks its
 etcd lease before serving).
+
+r16 (edl-lint resource-lifecycle): LeaderElection grew close() —
+resign + a deterministic join of the loss-watcher thread (resign
+alone left the watcher to notice hold.stop within its poll period).
 """
 
 from __future__ import annotations
@@ -238,3 +242,14 @@ class LeaderElection:
 
     def resign(self) -> None:
         self.lock.release()
+
+    def close(self) -> None:
+        """Teardown: resign (release joins the keepalive thread) and
+        join the loss watcher. `resign` alone leaves the watcher to
+        notice `hold.stop` within its poll period; close is the
+        deterministic variant an owner's shutdown path wants (edl-lint
+        resource-lifecycle)."""
+        watcher, self._watcher = self._watcher, None
+        self.resign()
+        if watcher is not None:
+            watcher.join(timeout=2.0)

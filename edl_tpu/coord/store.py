@@ -31,11 +31,12 @@ Semantics:
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
+
+from edl_tpu.utils import config
 
 
 @dataclass(frozen=True)
@@ -77,7 +78,7 @@ class WatchBatch:
 def watch_enabled() -> bool:
     """The EDL_TPU_COORD_WATCH=0 escape hatch: restores pure polling in
     every converted consumer (read per call so tests can flip it)."""
-    return os.environ.get("EDL_TPU_COORD_WATCH", "1") != "0"
+    return config.env_flag("EDL_TPU_COORD_WATCH", True)
 
 
 def watch_resync_interval(default: float = 30.0) -> float:
@@ -85,13 +86,7 @@ def watch_resync_interval(default: float = 30.0) -> float:
     safety net (EDL_TPU_WATCH_RESYNC_S). The net catches what events
     cannot promise: missed wakeups, redis TTL expiry (no event), and
     user-callback failures."""
-    raw = os.environ.get("EDL_TPU_WATCH_RESYNC_S", "").strip()
-    if raw:
-        try:
-            return max(0.1, float(raw))
-        except ValueError:
-            pass
-    return default
+    return max(0.1, config.env_float("EDL_TPU_WATCH_RESYNC_S", default))
 
 
 def try_watch(store: "Store", prefix: str = "", start_revision: int | None
@@ -154,9 +149,9 @@ class InMemWatch(Watch):
         self.prefix = prefix
         self._max = max_pending
         self._cond = threading.Condition()
-        self._queue: deque[WatchBatch] = deque()
-        self._pending_events = 0
-        self._cancelled = False
+        self._queue: deque[WatchBatch] = deque()  # guarded-by: _cond
+        self._pending_events = 0                  # guarded-by: _cond
+        self._cancelled = False                   # guarded-by: _cond
 
     # -- producer side (store lock held) ------------------------------------
 
@@ -280,25 +275,26 @@ class InMemStore(Store):
     def __init__(self, clock=time.monotonic, max_events: int = _MAX_EVENTS):
         self._clock = clock
         self._lock = threading.RLock()
-        self._data: dict[str, Record] = {}
-        self._leases: dict[int, _Lease] = {}
-        self._revision = 0
-        self._next_lease = 1
-        self._events: list[Event] = []
+        self._data: dict[str, Record] = {}    # guarded-by: _lock
+        self._leases: dict[int, _Lease] = {}  # guarded-by: _lock
+        self._revision = 0                    # guarded-by: _lock
+        self._next_lease = 1                  # guarded-by: _lock
+        self._events: list[Event] = []        # guarded-by: _lock
         self._max_events = max_events
-        self._first_event_rev = 1  # events older than this were compacted
-        self._watchers: list[InMemWatch] = []
+        # events older than this were compacted
+        self._first_event_rev = 1             # guarded-by: _lock
+        self._watchers: list[InMemWatch] = []  # guarded-by: _lock
         # public Store-API calls served (bench: poll- vs watch-mode
         # request volume); watch deliveries are pushes, not requests
-        self.op_count = 0
+        self.op_count = 0                     # guarded-by: _lock
 
     # -- internals ---------------------------------------------------------
 
-    def _bump(self) -> int:
+    def _bump(self) -> int:  # holds-lock: _lock
         self._revision += 1
         return self._revision
 
-    def _emit(self, ev: Event) -> None:
+    def _emit(self, ev: Event) -> None:  # holds-lock: _lock
         self._events.append(ev)
         if len(self._events) > self._max_events:
             drop = len(self._events) - self._max_events
@@ -308,7 +304,7 @@ class InMemStore(Store):
             if ev.key.startswith(watcher.prefix):
                 watcher._push(ev)
 
-    def _expire(self) -> None:
+    def _expire(self) -> None:  # holds-lock: _lock
         now = self._clock()
         dead = [l for l in self._leases.values() if l.deadline <= now]
         for lease in dead:
@@ -318,12 +314,12 @@ class InMemStore(Store):
                     self._emit(Event("DELETE", key, rec.value, self._bump()))
             del self._leases[lease.id]
 
-    def _check_lease(self, lease: int) -> None:
+    def _check_lease(self, lease: int) -> None:  # holds-lock: _lock
         if lease and lease not in self._leases:
             from edl_tpu.utils.exceptions import EdlLeaseExpired
             raise EdlLeaseExpired(f"lease {lease} unknown or expired")
 
-    def _detach(self, key: str, rec: Record) -> None:
+    def _detach(self, key: str, rec: Record) -> None:  # holds-lock: _lock
         if rec.lease and rec.lease in self._leases:
             self._leases[rec.lease].keys.discard(key)
 

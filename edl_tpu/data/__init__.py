@@ -1,21 +1,54 @@
-from edl_tpu.data.data_server import DataServer, RemoteSource
-from edl_tpu.data.image import (JpegFileListSource, decode_jpeg,
-                                encode_jpeg, eval_image_transform,
-                                train_image_transform)
-from edl_tpu.data.packed_records import (PackedSource, PackedWriter,
-                                         pack_jpeg_list, pack_npz,
-                                         pack_source)
-from edl_tpu.data.pipeline import (ArraySource, DataLoader, FileSource,
-                                   epoch_indices, prefetch,
-                                   prefetch_to_device)
-from edl_tpu.data.task_loader import (TaskDataLoader, npz_loader,
-                                      text_loader)
-from edl_tpu.data.task_master import TaskMaster, file_list_specs
+"""Input-plane package.
 
-__all__ = ["ArraySource", "DataLoader", "DataServer", "FileSource",
-           "JpegFileListSource", "PackedSource", "PackedWriter",
-           "RemoteSource", "decode_jpeg", "encode_jpeg", "epoch_indices",
-           "eval_image_transform", "pack_jpeg_list", "pack_npz",
-           "pack_source", "prefetch", "prefetch_to_device",
-           "train_image_transform", "TaskDataLoader", "TaskMaster",
-           "file_list_specs", "npz_loader", "text_loader"]
+Lazy by design: importing ``edl_tpu.data`` (or any of its jax-free
+submodules — ``tensor_wire``, ``shm_ring``, ``data_server``) must not
+pull jax or cv2. ``pipeline``/``image``/``task_loader`` import jax or
+cv2 at module load, and the distill serving plane reaches
+``data.tensor_wire`` from processes that deliberately never load the
+accelerator stack (the jax-free-import contract pinned by
+``test_distill_import_is_jax_free``). The package namespace therefore
+resolves its public names through ``__getattr__`` (PEP 562), exactly
+like ``edl_tpu.distill`` does — the first *use* of ``DataLoader``
+imports pipeline, not the package import itself.
+"""
+
+_EXPORTS = {
+    "DataServer": "edl_tpu.data.data_server",
+    "RemoteSource": "edl_tpu.data.data_server",
+    "JpegFileListSource": "edl_tpu.data.image",
+    "decode_jpeg": "edl_tpu.data.image",
+    "encode_jpeg": "edl_tpu.data.image",
+    "eval_image_transform": "edl_tpu.data.image",
+    "train_image_transform": "edl_tpu.data.image",
+    "PackedSource": "edl_tpu.data.packed_records",
+    "PackedWriter": "edl_tpu.data.packed_records",
+    "pack_jpeg_list": "edl_tpu.data.packed_records",
+    "pack_npz": "edl_tpu.data.packed_records",
+    "pack_source": "edl_tpu.data.packed_records",
+    "ArraySource": "edl_tpu.data.pipeline",
+    "DataLoader": "edl_tpu.data.pipeline",
+    "FileSource": "edl_tpu.data.pipeline",
+    "epoch_indices": "edl_tpu.data.pipeline",
+    "prefetch": "edl_tpu.data.pipeline",
+    "prefetch_to_device": "edl_tpu.data.pipeline",
+    "TaskDataLoader": "edl_tpu.data.task_loader",
+    "npz_loader": "edl_tpu.data.task_loader",
+    "text_loader": "edl_tpu.data.task_loader",
+    "TaskMaster": "edl_tpu.data.task_master",
+    "file_list_specs": "edl_tpu.data.task_master",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module 'edl_tpu.data' has no attribute "
+                             f"{name!r}")
+    import importlib
+    return getattr(importlib.import_module(module), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

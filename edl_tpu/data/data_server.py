@@ -5,7 +5,7 @@ Working capability of the reference's WIP pod data-server pair
 utils/distribute_reader.py:17-60 client fetching record batches from
 remote data servers) — finished and re-designed for this stack: the
 server exposes any pipeline *source* (`ArraySource`, `FileSource`) over
-the binary tensor wire (distill/tensor_wire.py), and `RemoteSource` IS a
+the binary tensor wire (data/tensor_wire.py), and `RemoteSource` IS a
 source (`__len__` + `batch(indices)`), so a `DataLoader` consumes remote
 records through the exact same deterministic shard-by-rank iteration it
 uses for local data.
@@ -23,6 +23,11 @@ Protocol (tensor-wire frames, meta carries control):
     -> {"op": "batch"} + idx tensor       <- {"ok": true} + record tensors
     -> {"op": "ping"}                     <- {"ok": true}
     errors:                               <- {"ok": false, "error": "..."}
+
+r16 (edl-lint resource-lifecycle): ``RemoteSource`` kept a socket with
+no teardown — it now has ``close()`` (``close_socket`` stays as the
+internal reconnect path), and the CLI stops the server on ANY exit
+path (try/finally), not just KeyboardInterrupt.
 """
 
 from __future__ import annotations
@@ -35,7 +40,7 @@ from typing import Any
 
 import numpy as np
 
-from edl_tpu.distill.tensor_wire import (TensorWireError, recv_tensors,
+from edl_tpu.data.tensor_wire import (TensorWireError, recv_tensors,
                                          send_tensors)
 from edl_tpu.utils.exceptions import EdlDataError
 from edl_tpu.utils.logging import get_logger
@@ -53,7 +58,7 @@ class DataServer:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._accept_thread: threading.Thread | None = None
-        self._conns: set[socket.socket] = set()
+        self._conns: set[socket.socket] = set()  # guarded-by: _conns_lock
         self._conns_lock = threading.Lock()
 
     def start(self) -> "DataServer":
@@ -193,12 +198,22 @@ class RemoteSource:
         return rmeta, rtensors
 
     def close_socket(self) -> None:
+        # holds no lock: called from _call (lock already held) and close()
         if self._sock is not None:
             try:
                 self._sock.close()
             except OSError:
                 pass
             self._sock = None
+
+    def close(self) -> None:
+        """Release the connection. The source stays usable — `_call`
+        reconnects lazily — so an owner may close eagerly between
+        epochs. (edl-lint resource-lifecycle: this is the teardown a
+        kept socket requires; `close_socket` remains the internal
+        reconnect path.)"""
+        with self._lock:
+            self.close_socket()
 
     def __len__(self) -> int:
         if self._n is None:
@@ -234,6 +249,8 @@ def main(argv=None) -> int:
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
+        pass
+    finally:
         server.stop()
     return 0
 

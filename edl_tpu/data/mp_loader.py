@@ -41,7 +41,6 @@ pinned off at import (data/image.py), which keeps fork safe.
 from __future__ import annotations
 
 import collections
-import os
 import queue
 import signal
 import time
@@ -54,6 +53,7 @@ import multiprocessing as mp
 import numpy as np
 
 from edl_tpu.data import shm_ring
+from edl_tpu.utils import config
 from edl_tpu.utils.exceptions import EdlDataError
 from edl_tpu.utils.logging import get_logger
 
@@ -365,10 +365,7 @@ class MpLoaderPool:
 
 def default_num_workers() -> int:
     """The `EDL_TPU_LOADER_WORKERS` env contract (0 = inline/threaded)."""
-    try:
-        return max(0, int(os.environ.get("EDL_TPU_LOADER_WORKERS", "0")))
-    except ValueError:
-        return 0
+    return max(0, config.env_int("EDL_TPU_LOADER_WORKERS", 0))
 
 
 def probe_slot_bytes(batch: dict[str, np.ndarray]) -> int:

@@ -122,7 +122,8 @@ class FileSource:
         # insertion/recency-ordered LRU: hits refresh via O(1)
         # move_to_end (the old list.remove hit path was O(cache) under
         # the lock — measurable with many concurrent DataServer readers)
-        self._cache: OrderedDict[int, dict[str, np.ndarray]] = OrderedDict()
+        self._cache: OrderedDict[int, dict[str, np.ndarray]] = \
+            OrderedDict()  # guarded-by: _cache_lock
         self._meta: dict[str, tuple[tuple[int, ...], np.dtype]] | None = None
         self.cache_files = cache_files
         # DataServer serves one source from a thread per connection; the

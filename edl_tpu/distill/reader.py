@@ -37,7 +37,6 @@ tests/test_distill_reader.py under teacher kill/join):
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
@@ -48,6 +47,7 @@ from typing import Callable, Iterable, Iterator
 import numpy as np
 
 from edl_tpu.distill.teacher_server import TeacherClient
+from edl_tpu.utils import config
 from edl_tpu.utils.exceptions import EdlError
 from edl_tpu.utils.logging import get_logger
 from edl_tpu.utils.timeline import timeline
@@ -377,7 +377,7 @@ class DistillReader:
         if sparse_predicts and not compress_topk:
             raise EdlDistillError("sparse_predicts requires compress_topk")
         if client_factory is None:
-            if os.environ.get("EDL_TPU_DISTILL_NOP", "0") == "1":
+            if config.env_flag("EDL_TPU_DISTILL_NOP", False):
                 client_factory = lambda ep: _NopTeacherClient(  # noqa: E731
                     ep, self._wire_predicts)
             else:

@@ -176,6 +176,8 @@ def main(argv=None) -> int:
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
+        pass
+    finally:
         registrar.stop()
     return 0
 

@@ -32,6 +32,12 @@ Wire compression (two independent levers; see `compress_outputs`):
 
 CLI (serves a zoo model with random or checkpointed params):
     python -m edl_tpu.distill.teacher_server --model mlp --port 23900
+
+r16 (edl-lint guarded-by): the Batcher's shared counters are annotated
+``# guarded-by: _stats_lock`` and machine-checked; the checker's first
+dry run caught ``_window_ema_s`` being updated by the coalesce thread
+OUTSIDE the lock while ``stats()`` read it under the lock — the EMA
+update now takes ``_stats_lock``.
 """
 
 from __future__ import annotations
@@ -47,7 +53,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from edl_tpu.distill import tensor_wire
+from edl_tpu.data import tensor_wire
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.distill.teacher_server")
@@ -158,29 +164,33 @@ class Batcher:
         ]
         # adaptive-window state: groups currently past coalesce (queued,
         # computing, or fetching) — the "device busy" signal; plus an EMA
-        # of realized window lengths for observability
-        self._groups_inflight = 0
-        self._window_ema_s = max_wait
-        self._carry: _Request | None = None
+        # of realized window lengths for observability. All mutated from
+        # three stage threads + read by the registrar's stats scrape, so
+        # every field below is annotated for the guarded-by checker.
+        self._stats_lock = threading.Lock()
+        self._groups_inflight = 0    # guarded-by: _stats_lock
+        self._window_ema_s = max_wait  # guarded-by: _stats_lock
+        self._carry: _Request | None = None  # coalesce-thread-only
         # Cumulative utilization counters (the registry `info` data source:
         # reference discovery/register.py:36-40 reserves the field for
         # "report job performance to the scheduler").
-        self._stats_lock = threading.Lock()
-        self._served_rows = 0
-        self._served_requests = 0
-        self._busy_s = 0.0
-        self._busy_until = 0.0   # interval-union accounting across stages
+        self._served_rows = 0        # guarded-by: _stats_lock
+        self._served_requests = 0    # guarded-by: _stats_lock
+        self._busy_s = 0.0           # guarded-by: _stats_lock
+        # interval-union accounting across stages
+        self._busy_until = 0.0       # guarded-by: _stats_lock
         self._started_at = time.monotonic()
-        self._pending_hwm = 0    # intake high-water mark: observed demand
+        # intake high-water mark: observed demand
+        self._pending_hwm = 0        # guarded-by: _stats_lock
         # Coalescing histogram: device-batch ROW count (pre-padding) ->
         # number of served groups. Whether concurrent client requests
         # actually merge (vs degenerate 1-request batches) is THE
         # efficiency question for a serving pool; the histogram makes it
         # observable instead of inferred.
-        self._batch_hist: dict[int, int] = {}
+        self._batch_hist: dict[int, int] = {}  # guarded-by: _stats_lock
         # Per-request latency histogram (fixed buckets, cumulative):
         # the SLO signal the serving scaler consumes. inf = overflow.
-        self._lat_hist: dict[float, int] = {}
+        self._lat_hist: dict[float, int] = {}  # guarded-by: _stats_lock
 
     def start(self) -> "Batcher":
         for t in self._threads:
@@ -240,7 +250,8 @@ class Batcher:
             group.append(req)
             rows += req.rows
         window = time.monotonic() - t_first
-        self._window_ema_s += 0.2 * (window - self._window_ema_s)
+        with self._stats_lock:
+            self._window_ema_s += 0.2 * (window - self._window_ema_s)
         return group
 
     def _fail_group(self, group: list[_Request], exc: Exception) -> None:
@@ -813,17 +824,20 @@ def _build_model_predict(model_name: str, num_classes: int, params_path: str,
             mgr = CheckpointManager(local, remote=params_path)
         else:
             mgr = CheckpointManager(rest if scheme == "file" else params_path)
-        # Structure-free: the trainer's checkpoint carries ITS optimizer
-        # state (momentum/wd chains) which the serving process neither
-        # has nor wants — take only the model sub-trees.
-        restored = mgr.restore_raw()
-        if restored is not None:
-            raw = restored[0]
-            state = state.replace(params=raw["params"],
-                                  batch_stats=raw.get("batch_stats")
-                                  or state.batch_stats)
-            log.info("teacher params restored from %s (epoch=%d)",
-                     params_path, restored[1].epoch)
+        try:
+            # Structure-free: the trainer's checkpoint carries ITS
+            # optimizer state (momentum/wd chains) which the serving
+            # process neither has nor wants — take the model sub-trees.
+            restored = mgr.restore_raw()
+            if restored is not None:
+                raw = restored[0]
+                state = state.replace(params=raw["params"],
+                                      batch_stats=raw.get("batch_stats")
+                                      or state.batch_stats)
+                log.info("teacher params restored from %s (epoch=%d)",
+                         params_path, restored[1].epoch)
+        finally:
+            mgr.close(raise_errors=False)
 
     variables = {"params": state.params}
     if state.batch_stats is not None:

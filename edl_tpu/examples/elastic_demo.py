@@ -304,6 +304,7 @@ def run_serve_scaler_demo(args) -> int:
             try:
                 client = clients.get(ep)
                 if client is None:
+                    # lifecycle: long-lived(pooled per-endpoint client; closed on dict eviction above and drained at loop end)
                     client = TeacherClient(ep, timeout=30.0,
                                            max_inflight=64)
                     clients[ep] = client

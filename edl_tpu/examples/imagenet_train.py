@@ -44,6 +44,7 @@ from edl_tpu.data.pipeline import (DataLoader, FileSource,
                                    prefetch_to_device, random_crop,
                                    random_flip_lr)
 from edl_tpu.parallel import distributed, mesh as mesh_lib
+from edl_tpu.utils import config
 from edl_tpu.train import lr as lr_lib
 from edl_tpu.train.benchlog import BenchmarkLog
 from edl_tpu.train.classification import (create_state,
@@ -263,7 +264,7 @@ def main(argv=None) -> int:
     if args.augment_device is not None:
         augment_device = bool(args.augment_device)
     else:
-        env_aug = os.environ.get("EDL_TPU_AUGMENT_DEVICE")
+        env_aug = config.env_str("EDL_TPU_AUGMENT_DEVICE")
         augment_device = (env_aug.lower() in ("1", "true", "yes", "on")
                           if env_aug is not None
                           else args.data_format == "packed")

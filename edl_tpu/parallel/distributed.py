@@ -22,6 +22,7 @@ import os
 import jax
 
 from edl_tpu.collective.job_env import TrainerEnv
+from edl_tpu.utils import config
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.parallel.distributed")
@@ -91,7 +92,7 @@ def enable_compilation_cache(cache_dir: str | None = None) -> bool:
     instead of rebuilding them. Thresholds drop to 0 so even quick
     compiles persist — an elastic restart replays ALL of them at once.
     """
-    cache_dir = cache_dir or os.environ.get("EDL_TPU_COMPILE_CACHE_DIR")
+    cache_dir = cache_dir or config.env_str("EDL_TPU_COMPILE_CACHE_DIR")
     if not cache_dir:
         return False
     try:

@@ -368,13 +368,15 @@ class TeacherPoolActuator:
         self.drain_poll_s = drain_poll_s
         self.drain_quiet_polls = drain_quiet_polls
         self.service = service
+        # control loop, drain threads, and test scrapes all touch the
+        # pool state — guarded-by annotations checked by edl-lint
         self._lock = threading.Lock()
-        self._teachers: list[TeacherHandle] = []
-        self._spawned = 0
-        self._drains: list[threading.Thread] = []
-        self.desired = 0
-        self.resize_log: list[dict] = []
-        self.drain_log: list[dict] = []
+        self._teachers: list[TeacherHandle] = []  # guarded-by: _lock
+        self._spawned = 0                         # guarded-by: _lock
+        self._drains: list[threading.Thread] = []  # guarded-by: _lock
+        self.desired = 0                          # guarded-by: _lock
+        self.resize_log: list[dict] = []          # guarded-by: _lock
+        self.drain_log: list[dict] = []           # guarded-by: _lock
 
     def pool_size(self) -> int:
         with self._lock:

@@ -106,17 +106,20 @@ class CheckpointManager:
         # wall seconds of the last restore() (elastic downtime accounting)
         self.last_restore_s: float | None = None
         # -- async snapshot-then-write plane (save_async) ------------------
+        # The training thread and the background writer share everything
+        # below under _cond (guarded-by annotations checked by edl-lint).
         self._cond = threading.Condition()
-        self._pending: dict | None = None   # drop-to-latest slot (size 1)
-        self._inflight = False
-        self._writer: threading.Thread | None = None
-        self._closed = False
-        self._write_error: BaseException | None = None
+        # drop-to-latest slot (size 1)
+        self._pending: dict | None = None   # guarded-by: _cond
+        self._inflight = False              # guarded-by: _cond
+        self._writer: threading.Thread | None = None  # guarded-by: _cond
+        self._closed = False                # guarded-by: _cond
+        self._write_error: BaseException | None = None  # guarded-by: _cond
         # double-buffered host staging: retired snapshot arenas recycled
         # by np.copyto instead of reallocating the full state per save
-        self._staging_free: list[list] = []
-        self._staging_key: tuple | None = None
-        self._async_fallback_logged = False
+        self._staging_free: list[list] = []   # guarded-by: _cond
+        self._staging_key: tuple | None = None  # guarded-by: _cond
+        self._async_fallback_logged = False   # training-thread-only
         # -- sealed-snapshot retention (state-migration donor plane) -------
         # When retain_sealed is set (collective/migration.py), the newest
         # successfully sealed save's HOST-side payload is kept in memory
@@ -127,15 +130,16 @@ class CheckpointManager:
         # over it would serve torn bytes; the old payload is simply
         # dropped and freed by GC once the last reader releases it.
         self.retain_sealed = False
-        self._sealed: dict | None = None
+        self._sealed: dict | None = None    # guarded-by: _cond
         # called (no args, outside the lock) after each retention update;
         # the migration service republishes its advert from here
         self.on_sealed = None
         self._tl = timeline("ckpt")
-        self._stats = {"saves_async": 0, "saves_sync": 0, "superseded": 0,
-                       "writes": 0, "errors": 0,
-                       "snapshot_ms_last": 0.0, "save_stall_ms_total": 0.0,
-                       "write_s_last": 0.0, "write_s_total": 0.0}
+        self._stats = {  # guarded-by: _cond
+            "saves_async": 0, "saves_sync": 0, "superseded": 0,
+            "writes": 0, "errors": 0,
+            "snapshot_ms_last": 0.0, "save_stall_ms_total": 0.0,
+            "write_s_last": 0.0, "write_s_total": 0.0}
 
     @property
     def process_index(self) -> int:
@@ -659,8 +663,7 @@ class CheckpointManager:
                 new_arena.append(None)
         return staged, new_arena
 
-    def _recycle_arena(self, job: dict) -> None:
-        # caller holds self._cond
+    def _recycle_arena(self, job: dict) -> None:  # holds-lock: _cond
         if (job.get("arena") is not None
                 and job.get("arena_key") == self._staging_key
                 and len(self._staging_free) < 2):

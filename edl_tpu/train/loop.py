@@ -398,6 +398,24 @@ class TrainLoop:
             if callable(closer):
                 closer()
 
+    def close(self) -> None:
+        """Teardown for a loop that never ran (or whose owner wants a
+        deterministic release without calling `run`): drain/stop the
+        checkpoint writer, migration donor and utilization publisher.
+        `run()` performs the same teardown on its own finally path —
+        this exists so an owner that builds a TrainLoop and aborts
+        before running it still has a joining close (edl-lint
+        resource-lifecycle); idempotent either way."""
+        if self.ckpt is not None:
+            self.ckpt.close(raise_errors=False)
+        if self._migration is not None:
+            try:
+                self._migration.shutdown()
+            except Exception:  # noqa: BLE001 — teardown
+                log.exception("migration shutdown failed")
+        if self._util_publisher is not None:
+            self._util_publisher.stop()
+
     def _profile_window(self) -> None:
         """Start/stop the jax profiler trace at the configured global
         steps (rank 0 only — one host's trace is the analysis unit)."""

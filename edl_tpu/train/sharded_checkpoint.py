@@ -44,6 +44,7 @@ from typing import Any
 import jax
 import numpy as np
 
+from edl_tpu.utils import config
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.train.sharded_checkpoint")
@@ -269,13 +270,9 @@ def restore_threads() -> int:
     """Region-read pool width for restore (the restore-side half of the
     elastic downtime budget). Env-tunable; defaults past 1 even on small
     hosts because the reads are mmap-page-in bound, not CPU bound."""
-    env = os.environ.get("EDL_TPU_CKPT_RESTORE_THREADS", "").strip()
-    if env:
-        try:
-            return max(1, int(env))
-        except ValueError:
-            log.warning("ignoring malformed EDL_TPU_CKPT_RESTORE_THREADS=%r",
-                        env)
+    configured = config.env_int("EDL_TPU_CKPT_RESTORE_THREADS", 0)
+    if configured > 0:
+        return configured
     return min(8, 2 * (os.cpu_count() or 1))
 
 

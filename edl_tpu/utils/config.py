@@ -18,6 +18,160 @@ from typing import Any, TypeVar
 
 T = TypeVar("T")
 
+# --------------------------------------------------------------------------
+# The central EDL_TPU_* knob registry.
+#
+# Single source of truth for every environment variable the package
+# reads: a knob exists iff it has a row here, a row in the doc/usage.md
+# env reference table, and at least one live read (a `field(env=...)`
+# declaration or an `env_*` helper call).  All three are machine-checked
+# by `python -m edl_tpu.analysis lint` (the env-registry checker), so
+# source<->doc drift fails CI instead of accumulating — the reference
+# shipped ~70 ad-hoc PADDLE_* reads against a doc page covering a
+# fraction of them, and this repo was on the same trajectory.
+#
+# Direct `os.environ` reads of EDL_TPU_* names outside this module are
+# lint findings; use env_str/env_int/env_float/env_flag/env_present or
+# `field(env=...)`.
+
+ENV_VARS: dict[str, str] = {
+    # -- identity / membership (launcher -> trainer contract) --------------
+    "EDL_TPU_JOB_ID": "job identifier shared by every pod of one job",
+    "EDL_TPU_POD_ID": "this pod's unique id within the job",
+    "EDL_TPU_RANK": "trainer rank within the elastic world",
+    "EDL_TPU_WORLD_SIZE": "elastic world size (launcher pod count)",
+    "EDL_TPU_COORDINATOR": "jax distributed coordinator endpoint",
+    "EDL_TPU_CLUSTER_JSON": "serialized Cluster doc handed to trainers",
+    "EDL_TPU_CLUSTER_VERSION": "cluster generation the trainer launched into",
+    "EDL_TPU_STORE_ENDPOINTS": "coordination store endpoints (comma-joined)",
+    "EDL_TPU_NODES_RANGE": "elastic node range 'min:max'",
+    "EDL_TPU_NPROC_PERNODE": "trainer processes per node (0 = auto)",
+    "EDL_TPU_UP_LIMIT_NODES": "hard ceiling on world growth",
+    "EDL_TPU_JOBSERVER": "JobServer endpoint for resize control",
+    "EDL_TPU_SLICES": "multi-slice topology: number of slices",
+    "EDL_TPU_SLICE_ID": "this trainer's slice index (rank-contiguous)",
+    # -- barriers / leases / rejoin ----------------------------------------
+    "EDL_TPU_LEASE_TTL": "store lease TTL seconds for pod claims",
+    "EDL_TPU_BARRIER_STABLE": "seconds membership must hold still to pass "
+                              "the elastic barrier",
+    "EDL_TPU_BARRIER_TIMEOUT": "elastic barrier hard timeout seconds",
+    "EDL_TPU_REJOIN_DELAY": "pod rejoin backoff seconds after a kick",
+    # -- checkpoint plane ---------------------------------------------------
+    "EDL_TPU_CHECKPOINT_PATH": "checkpoint directory root",
+    "EDL_TPU_CHECKPOINT_KEEP": "sealed checkpoint versions to retain",
+    "EDL_TPU_CHECKPOINT_SHARDED": "per-process sharded checkpoint format",
+    "EDL_TPU_CKPT_REMOTE": "remote mirror URI (gs:// / hdfs:// / file://)",
+    "EDL_TPU_CKPT_ASYNC": "async snapshot-then-write saves (0 = sync)",
+    "EDL_TPU_CKPT_STEPS": "save every N steps (0 = per-epoch only)",
+    "EDL_TPU_SAVE_CHECKPOINT_STEPS": "alias of EDL_TPU_CKPT_STEPS "
+                                     "(reference env-name parity)",
+    "EDL_TPU_SAVE_CHECKPOINT_INTER": "save every N epochs",
+    "EDL_TPU_CKPT_RESTORE_THREADS": "parallel restore read threads",
+    "EDL_TPU_COMPILE_CACHE_DIR": "persistent XLA compilation cache dir",
+    # -- p2p live state migration ------------------------------------------
+    "EDL_TPU_RESIZE_P2P": "peer-to-peer live state migration (0 = "
+                          "stop-resume from disk)",
+    "EDL_TPU_DONOR_LINGER": "seconds a released trainer keeps serving its "
+                            "sealed snapshot",
+    "EDL_TPU_ADOPT_TIMEOUT": "launcher wait for in-place adoption before "
+                             "stop-resume",
+    # -- train loop / input plane ------------------------------------------
+    "EDL_TPU_NUM_EPOCHS": "epochs to train",
+    "EDL_TPU_LOG_EVERY": "log metrics every N steps",
+    "EDL_TPU_PREFETCH_BATCHES": "host->device prefetch depth",
+    "EDL_TPU_LOADER_WORKERS": "mp input-plane worker processes (0 = inline)",
+    "EDL_TPU_AUGMENT_DEVICE": "jitted on-device crop/flip/normalize",
+    "EDL_TPU_DISTILL_NOP": "distill reader no-op mode (wire debugging)",
+    # -- logging / profiling ------------------------------------------------
+    "EDL_TPU_LOG_DIR": "launcher workerlog directory",
+    "EDL_TPU_LOG_LEVEL": "python log level for edl_tpu loggers",
+    "EDL_TPU_PROFILE": "timeline tracing on/off",
+    "EDL_TPU_PROFILE_DIR": "jax profiler trace output directory",
+    "EDL_TPU_PROFILE_START": "profiler start step",
+    "EDL_TPU_PROFILE_STEPS": "profiler step count",
+    # -- control plane (watch streams, utilization) ------------------------
+    "EDL_TPU_COORD_WATCH": "store watch streams (0 = poll everywhere)",
+    "EDL_TPU_WATCH_RESYNC_S": "resync safety-net period for event-driven "
+                              "consumers",
+    "EDL_TPU_PUBLISH_UTIL": "trainer utilization publishing (0 = off)",
+    # -- autoscaler (trainer worlds) ---------------------------------------
+    "EDL_TPU_SCALER_INTERVAL": "fallback decision interval seconds",
+    "EDL_TPU_SCALER_MIN_TICK": "floor between event-triggered passes",
+    "EDL_TPU_SCALER_COOLDOWN": "per-job resize cooldown seconds",
+    "EDL_TPU_SCALER_GAIN": "marginal-gain threshold to grow",
+    "EDL_TPU_SCALER_STALENESS": "utilization record staleness bound",
+    "EDL_TPU_SCALER_MIN_NODES": "per-job world floor",
+    "EDL_TPU_SCALER_MAX_NODES": "per-job world ceiling",
+    "EDL_TPU_SCALER_LEADER_TTL": "scaler leader-election lease TTL",
+    "EDL_TPU_ELASTIC_DOWNTIME_S": "seed value for the per-resize downtime "
+                                  "charge",
+    "EDL_TPU_DOWNTIME_ARTIFACT": "bench JSON to seed the downtime charge "
+                                 "from",
+    # -- serving elasticity (teacher pools) --------------------------------
+    "EDL_TPU_SERVE_SLO_P95_MS": "serving latency SLO target (p95, ms)",
+    "EDL_TPU_SERVE_QUEUE_HIGH": "queued requests per teacher counting as "
+                                "a breach",
+    "EDL_TPU_SERVE_UTIL_LOW": "shrink only under this mean utilization",
+    "EDL_TPU_SERVE_SHRINK_HEADROOM": "shrink only with p95 under this "
+                                     "fraction of the SLO",
+    "EDL_TPU_SERVE_BREACH_TICKS": "consecutive breach ticks before a grow",
+    "EDL_TPU_SERVE_IDLE_TICKS": "consecutive idle ticks before a shrink",
+    "EDL_TPU_SERVE_COOLDOWN": "serving resize cooldown seconds",
+    "EDL_TPU_SERVE_GROW_FACTOR": "multiplicative grow cap",
+    "EDL_TPU_SERVE_MIN_TEACHERS": "pool floor",
+    "EDL_TPU_SERVE_MAX_TEACHERS": "pool ceiling",
+    "EDL_TPU_SERVE_DRAIN_DEADLINE": "graceful-drain budget before "
+                                    "hard-kill",
+    # -- analysis plane -----------------------------------------------------
+    "EDL_TPU_LOCKGRAPH": "lock-order race detector during pytest (1 = on)",
+    "EDL_TPU_LOCKGRAPH_OUT": "lockgraph JSON report path",
+}
+
+
+def _declared(name: str) -> str:
+    if name not in ENV_VARS:
+        raise KeyError(
+            f"{name} is not declared in edl_tpu.utils.config.ENV_VARS — "
+            "add a declaration (and a doc/usage.md row); "
+            "'python -m edl_tpu.analysis lint' enforces this")
+    return name
+
+
+def env_str(name: str, default: str | None = None) -> str | None:
+    """Read a declared knob as a string (None/default when unset)."""
+    value = os.environ.get(_declared(name))
+    return default if value is None or value == "" else value
+
+
+def env_int(name: str, default: int = 0) -> int:
+    value = os.environ.get(_declared(name), "").strip()
+    try:
+        return int(value) if value else default
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float = 0.0) -> float:
+    value = os.environ.get(_declared(name), "").strip()
+    try:
+        return float(value) if value else default
+    except ValueError:
+        return default
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Truthy env parse ('1'/'true'/'yes'/'on'), same grammar as
+    `from_env`'s bool fields."""
+    value = os.environ.get(_declared(name))
+    if value is None:
+        return default
+    return value.lower() in ("1", "true", "yes", "on")
+
+
+def env_present(name: str) -> bool:
+    """Is the declared knob set at all (the 'under the launcher?' probe)."""
+    return _declared(name) in os.environ
+
 
 def field(default: Any = dataclasses.MISSING, *,
           env: str | tuple[str, ...] | None = None, **kw):
@@ -57,6 +211,8 @@ def from_env(cls: type[T], **overrides: Any) -> T:
         env_name = f.metadata.get("env")
         names = (env_name,) if isinstance(env_name, str) else (env_name or ())
         for name in names:
+            if name.startswith("EDL_TPU_"):
+                _declared(name)   # typo'd knobs fail loudly, not silently
             if name in os.environ:
                 kwargs[f.name] = _parse(os.environ[name],
                                         hints.get(f.name, str))

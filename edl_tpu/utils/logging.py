@@ -8,8 +8,9 @@ handled in collective/process.py here).
 from __future__ import annotations
 
 import logging
-import os
 import sys
+
+from edl_tpu.utils import config
 
 _FORMAT = "%(asctime)s %(levelname)s %(name)s [%(process)d] %(message)s"
 
@@ -26,6 +27,6 @@ def get_logger(name: str, level: int | str | None = None) -> logging.Logger:
         logger.addHandler(handler)
         logger.propagate = False
         if level is None:
-            level = os.environ.get("EDL_TPU_LOG_LEVEL", "INFO")
+            level = config.env_str("EDL_TPU_LOG_LEVEL", "INFO")
         logger.setLevel(level)
     return logger

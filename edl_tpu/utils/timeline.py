@@ -19,6 +19,8 @@ import os
 import sys
 import time
 
+from edl_tpu.utils import config
+
 
 class _NopTimeline:
     __slots__ = ()
@@ -54,7 +56,7 @@ class _RealTimeline:
 
 
 def profiling_enabled() -> bool:
-    return os.environ.get("EDL_TPU_PROFILE", "0") == "1"
+    return config.env_flag("EDL_TPU_PROFILE", False)
 
 
 def timeline(name: str):

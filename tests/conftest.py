@@ -14,6 +14,18 @@ import os
 
 os.environ.setdefault("EDL_TPU_TEST_DEVICES", "8")
 
+# -- lockgraph plugin (EDL_TPU_LOCKGRAPH=1) ---------------------------------
+# Install the lock-order recorder BEFORE any edl_tpu module is imported so
+# module-level locks are created through the patched factories. The whole
+# run then doubles as a deadlock audit: pytest_sessionfinish (below)
+# analyzes the global lock-order graph and FAILS the session on any cycle
+# (potential ABBA deadlock), with both acquisition stacks in the report.
+# See edl_tpu/analysis/lockgraph.py and doc/design_analysis.md.
+_LOCKGRAPH = None
+if os.environ.get("EDL_TPU_LOCKGRAPH", "") == "1":
+    from edl_tpu.analysis import lockgraph as _lockgraph_mod
+    _LOCKGRAPH = _lockgraph_mod.install()
+
 # Keep the ambient env consistent with the config below: in-process code
 # that applies the env contract (parallel/distributed.py
 # force_platform_from_env, e.g. examples run inside tests) must re-apply
@@ -64,3 +76,17 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _LOCKGRAPH is None:
+        return
+    rep = _lockgraph_mod.write_report(_LOCKGRAPH,
+                                      _lockgraph_mod.default_report_path())
+    print(f"\nlockgraph: {rep['locks_tracked']} lock sites, "
+          f"{rep['edges']} order edges, {len(rep['cycles'])} cycle(s), "
+          f"{len(rep['hazards'])} hazard(s) -> "
+          f"{_lockgraph_mod.default_report_path()}")
+    if not rep["ok"]:
+        print(_lockgraph_mod.render_failure(rep))
+        session.exitstatus = 1

@@ -1,0 +1,604 @@
+"""edl-lint + lockgraph: fixture snippets per checker (caught + clean),
+suppression grammar, lockgraph seeded hazards, and the dogfood pins —
+the real repo lints clean and the analysis package imports jax/numpy
+free."""
+
+import json
+import os
+import queue
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from edl_tpu.analysis import lockgraph
+from edl_tpu.analysis.core import (Finding, LintResult, Project,
+                                   load_toml_lite, run_lint)
+from edl_tpu.analysis.checks import CHECKS
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_project(tmp_path, files: dict[str, str], config: dict) -> Project:
+    for rel, text in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    config = dict(config)
+    config.setdefault("lint", {"paths": sorted(
+        {rel.split("/")[0] for rel in files if rel.endswith(".py")})})
+    return Project(str(tmp_path), config)
+
+
+def findings_of(project: Project, check: str) -> list[Finding]:
+    return sorted(CHECKS[check](project), key=lambda f: (f.path, f.line))
+
+
+# -- toml-lite ---------------------------------------------------------------
+
+
+class TestTomlLite:
+    def test_parses_the_layers_subset(self):
+        cfg = load_toml_lite(
+            '# comment\n[layers.coord]\npackages = ["a", "b"]\n'
+            'n = 3\nf = 1.5\nflag = true\nname = "x"\n')
+        assert cfg["layers"]["coord"]["packages"] == ["a", "b"]
+        assert cfg["layers"]["coord"]["n"] == 3
+        assert cfg["layers"]["coord"]["flag"] is True
+
+    def test_rejects_what_it_cannot_parse(self):
+        with pytest.raises(ValueError):
+            load_toml_lite("key = [unquoted")
+        with pytest.raises(ValueError):
+            load_toml_lite("just a line\n")
+
+    def test_the_real_layers_toml_loads(self):
+        path = os.path.join(REPO_ROOT, "edl_tpu/analysis/layers.toml")
+        with open(path) as f:
+            cfg = load_toml_lite(f.read())
+        assert "coord" in cfg["layers"]
+        assert "edl_tpu/scaler/simulator.py" in cfg["determinism"]["files"]
+
+
+# -- layering ----------------------------------------------------------------
+
+_LAYER_CFG = {"layers": {"pure": {"packages": ["pkg/pure"],
+                                  "forbidden": ["numpy"]}}}
+
+
+class TestLayering:
+    def test_direct_violation_caught_with_chain(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/pure/__init__.py": "",
+            "pkg/pure/mod.py": "import numpy as np\n",
+        }, _LAYER_CFG)
+        found = findings_of(project, "layering")
+        assert len(found) == 1
+        assert "must not import 'numpy'" in found[0].message
+        assert found[0].path == "pkg/pure/mod.py" and found[0].line == 1
+
+    def test_transitive_violation_names_the_chain(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/pure/__init__.py": "",
+            "pkg/pure/mod.py": "from pkg.helper import x\n",
+            "pkg/helper.py": "import numpy\nx = 1\n",
+        }, _LAYER_CFG)
+        found = findings_of(project, "layering")
+        assert len(found) == 1
+        assert "pkg/helper.py" in found[0].message  # the chain hop
+        # anchored at the ROOT file's import line (where the fix goes)
+        assert found[0].path == "pkg/pure/mod.py" and found[0].line == 1
+
+    def test_function_scoped_and_type_checking_imports_are_exempt(
+            self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/pure/__init__.py": "",
+            "pkg/pure/mod.py": """\
+                from typing import TYPE_CHECKING
+                if TYPE_CHECKING:
+                    import numpy
+                def f():
+                    import numpy as np
+                    return np
+            """,
+        }, _LAYER_CFG)
+        assert findings_of(project, "layering") == []
+
+
+# -- env-registry ------------------------------------------------------------
+
+_ENV_CFG = {"env": {"config_module": "pkg/config.py", "doc": "doc.md",
+                    "prefix": "EDL_TPU_"}}
+
+_CONFIG_WITH = """\
+    ENV_VARS = {"EDL_TPU_GOOD": "a documented knob"}
+    import os
+    def env_str(name, default=None):
+        return os.environ.get(name, default)
+"""
+
+
+class TestEnvRegistry:
+    def test_direct_read_outside_config_flagged(self, tmp_path):
+        (tmp_path / "doc.md").write_text("| `EDL_TPU_GOOD` | ok |\n")
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/config.py": _CONFIG_WITH,
+            "pkg/user.py": 'import os\nv = os.environ["EDL_TPU_GOOD"]\n',
+        }, _ENV_CFG)
+        msgs = [f.message for f in findings_of(project, "env-registry")]
+        assert any("direct environment read" in m for m in msgs)
+
+    def test_undeclared_and_undocumented_and_dead_row(self, tmp_path):
+        (tmp_path / "doc.md").write_text(
+            "| `EDL_TPU_GOOD` | ok |\n| `EDL_TPU_GONE` | dead row |\n")
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/config.py": _CONFIG_WITH.replace(
+                '{"EDL_TPU_GOOD": "a documented knob"}',
+                '{"EDL_TPU_GOOD": "ok", "EDL_TPU_UNDOC": "no doc row"}'),
+            "pkg/user.py": """\
+                from pkg.config import env_str
+                a = env_str("EDL_TPU_GOOD")
+                b = env_str("EDL_TPU_UNDOC")
+                c = env_str("EDL_TPU_MYSTERY")
+            """,
+        }, _ENV_CFG)
+        msgs = [f.message for f in findings_of(project, "env-registry")]
+        assert any("'EDL_TPU_MYSTERY' is not declared" in m for m in msgs)
+        assert any("'EDL_TPU_UNDOC' has no row" in m for m in msgs)
+        assert any("'EDL_TPU_GONE'" in m and "dead doc row" in m
+                   for m in msgs)
+
+    def test_dead_declaration_flagged(self, tmp_path):
+        (tmp_path / "doc.md").write_text(
+            "| `EDL_TPU_GOOD` | ok |\n| `EDL_TPU_UNREAD` | doc |\n")
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/config.py": _CONFIG_WITH.replace(
+                '{"EDL_TPU_GOOD": "a documented knob"}',
+                '{"EDL_TPU_GOOD": "ok", "EDL_TPU_UNREAD": "nobody reads"}'),
+            "pkg/user.py": 'from pkg.config import env_str\n'
+                           'a = env_str("EDL_TPU_GOOD")\n',
+        }, _ENV_CFG)
+        msgs = [f.message for f in findings_of(project, "env-registry")]
+        assert any("'EDL_TPU_UNREAD' is never read" in m for m in msgs)
+
+    def test_clean_pass(self, tmp_path):
+        (tmp_path / "doc.md").write_text("| `EDL_TPU_GOOD` | ok |\n")
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/config.py": _CONFIG_WITH,
+            "pkg/user.py": 'from pkg.config import env_str\n'
+                           'a = env_str("EDL_TPU_GOOD")\n',
+        }, _ENV_CFG)
+        assert findings_of(project, "env-registry") == []
+
+
+# -- guarded-by --------------------------------------------------------------
+
+_GUARDED_BAD = """\
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0   # guarded-by: _lock
+        def bump(self):
+            self._count += 1
+"""
+
+_GUARDED_GOOD = """\
+    import threading
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0   # guarded-by: _lock
+            self._items = []  # guarded-by: _lock
+        def bump(self):
+            with self._lock:
+                self._count += 1
+                self._items.append(1)
+        def _bump_locked(self):  # holds-lock: _lock
+            self._count += 1
+"""
+
+
+class TestGuardedBy:
+    def test_unlocked_mutation_caught(self, tmp_path):
+        project = make_project(tmp_path, {"pkg/__init__.py": "",
+                                          "pkg/m.py": _GUARDED_BAD}, {})
+        found = findings_of(project, "guarded-by")
+        assert len(found) == 1
+        assert "self._count" in found[0].message
+        assert found[0].line == 7
+
+    def test_locked_and_holds_lock_clean(self, tmp_path):
+        project = make_project(tmp_path, {"pkg/__init__.py": "",
+                                          "pkg/m.py": _GUARDED_GOOD}, {})
+        assert findings_of(project, "guarded-by") == []
+
+    def test_closure_inside_with_is_not_blessed(self, tmp_path):
+        # `with lock:` around a nested def does NOT protect the closure
+        # body at runtime — the thread runs it after the lock is dropped
+        project = make_project(tmp_path, {"pkg/__init__.py": "", "pkg/m.py": """\
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0   # guarded-by: _lock
+                def go(self):
+                    with self._lock:
+                        def work():
+                            self._n += 1
+                        return work
+        """}, {})
+        found = findings_of(project, "guarded-by")
+        assert len(found) == 1 and "self._n" in found[0].message
+
+    def test_mutating_method_call_caught(self, tmp_path):
+        project = make_project(tmp_path, {"pkg/__init__.py": "", "pkg/m.py": """\
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._items = []  # guarded-by: _lock
+                def add(self, x):
+                    self._items.append(x)
+        """}, {})
+        found = findings_of(project, "guarded-by")
+        assert len(found) == 1 and ".append() call" in found[0].message
+
+
+# -- resource-lifecycle ------------------------------------------------------
+
+
+class TestLifecycle:
+    def test_keeping_class_without_teardown_caught(self, tmp_path):
+        project = make_project(tmp_path, {"pkg/__init__.py": "", "pkg/m.py": """\
+            import threading
+            class Keeper:
+                def __init__(self):
+                    self._t = threading.Thread(target=lambda: None)
+        """}, {})
+        found = findings_of(project, "resource-lifecycle")
+        assert len(found) == 1 and "'Keeper'" in found[0].message
+
+    def test_method_local_joined_thread_is_not_ownership(self, tmp_path):
+        project = make_project(tmp_path, {"pkg/__init__.py": "", "pkg/m.py": """\
+            import threading
+            class Scoped:
+                def work(self):
+                    t = threading.Thread(target=lambda: None)
+                    t.start()
+                    t.join()
+        """}, {})
+        assert findings_of(project, "resource-lifecycle") == []
+
+    def test_leaky_instantiation_site_caught_and_fixes_pass(self, tmp_path):
+        project = make_project(tmp_path, {"pkg/__init__.py": "", "pkg/m.py": """\
+            import threading
+            class Res:
+                def __init__(self):
+                    self._t = threading.Thread(target=lambda: None)
+                def close(self):
+                    pass
+            def leak():
+                r = Res()          # no finally, no owner: finding
+                return 1
+            def ok_with():
+                with Res() as r:
+                    return r
+            def ok_finally():
+                r = Res()
+                try:
+                    return 1
+                finally:
+                    r.close()
+            def ok_factory():
+                return Res()
+            # lifecycle: long-lived(process singleton for the test)
+            GLOBAL = Res()
+        """}, {})
+        found = findings_of(project, "resource-lifecycle")
+        assert len(found) == 1
+        assert "'Res' instantiated without bounded ownership" \
+            in found[0].message
+
+    def test_ownership_handoff_to_closeable_owner_passes(self, tmp_path):
+        project = make_project(tmp_path, {"pkg/__init__.py": "", "pkg/m.py": """\
+            import threading
+            class Res:
+                def __init__(self):
+                    self._t = threading.Thread(target=lambda: None)
+                def close(self):
+                    pass
+            class Owner:
+                def __init__(self, res):
+                    self._res = res
+                def close(self):
+                    self._res.close()
+            def make():
+                r = Res()
+                return Owner(r)
+        """}, {})
+        assert findings_of(project, "resource-lifecycle") == []
+
+
+# -- sim-determinism ---------------------------------------------------------
+
+_DET_CFG = {"determinism": {"files": ["pkg/sim.py"]}}
+
+
+class TestDeterminism:
+    def test_wall_clock_and_global_rng_caught_transitively(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/sim.py": "from pkg.helper import now\n",
+            "pkg/helper.py": """\
+                import time, random
+                def now():
+                    return time.time() + random.random()
+            """,
+        }, _DET_CFG)
+        msgs = [f.message for f in findings_of(project, "sim-determinism")]
+        assert any("time.time()" in m for m in msgs)
+        assert any("random.random()" in m for m in msgs)
+
+    def test_seeded_rngs_and_virtual_clock_clean(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/sim.py": """\
+                import random
+                rng = random.Random(1234)
+                def tick(clock):
+                    return clock() + rng.random()
+            """,
+        }, _DET_CFG)
+        assert findings_of(project, "sim-determinism") == []
+
+    def test_argless_random_Random_caught(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/sim.py": "import random\nrng = random.Random()\n",
+        }, _DET_CFG)
+        found = findings_of(project, "sim-determinism")
+        assert len(found) == 1 and "argless random.Random()" \
+            in found[0].message
+
+
+# -- suppressions ------------------------------------------------------------
+
+
+class TestSuppressions:
+    def _cfg(self):
+        return dict(_LAYER_CFG)
+
+    def test_suppression_with_reason_honored(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/pure/__init__.py": "",
+            "pkg/pure/mod.py":
+                "import numpy  # edl-lint: disable=layering(numpy needed"
+                " for the fixture)\n",
+        }, self._cfg())
+        result = _run(project)
+        assert result.ok
+        assert len(result.suppressed) == 1
+        assert result.suppressed[0][1].reason \
+            == "numpy needed for the fixture"
+
+    def test_reason_is_mandatory(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/pure/__init__.py": "",
+            "pkg/pure/mod.py":
+                "import numpy  # edl-lint: disable=layering\n",
+        }, self._cfg())
+        assert any(f.check == "suppression" for f in project.errors)
+
+    def test_unused_suppression_is_a_finding(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/pure/__init__.py": "",
+            "pkg/pure/mod.py":
+                "x = 1  # edl-lint: disable=layering(stale reason)\n",
+        }, self._cfg())
+        result = _run(project)
+        assert not result.ok
+        assert result.findings[0].check == "unused-suppression"
+
+    def test_wrong_check_name_does_not_suppress(self, tmp_path):
+        project = make_project(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/pure/__init__.py": "",
+            "pkg/pure/mod.py":
+                "import numpy  # edl-lint: disable=guarded-by(wrong)\n",
+        }, self._cfg())
+        result = _run(project)
+        checks = {f.check for f in result.findings}
+        assert "layering" in checks and "unused-suppression" in checks
+
+
+def _run(project: Project) -> LintResult:
+    """run_lint against an in-memory Project (mirrors core.run_lint's
+    suppression accounting without re-loading from disk)."""
+    result = LintResult()
+    result.findings.extend(project.errors)
+    raw = []
+    for name in sorted(CHECKS):
+        raw.extend(CHECKS[name](project))
+    for sf in project.files.values():
+        for sups in sf.suppressions.values():
+            result.suppressions.extend(sups)
+    used = set()
+    for f in raw:
+        sf = project.files.get(f.path)
+        match = None
+        if sf is not None:
+            for s in sf.suppressions.get(f.line, []):
+                if s.check == f.check:
+                    match = s
+                    break
+        if match is not None:
+            result.suppressed.append((f, match))
+            used.add((match.path, match.line, match.check))
+        else:
+            result.findings.append(f)
+    for s in result.suppressions:
+        if (s.path, s.line, s.check) not in used:
+            result.findings.append(Finding(
+                "unused-suppression", s.path, s.line, "unused"))
+    return result
+
+
+# -- lockgraph ---------------------------------------------------------------
+
+
+class TestLockGraph:
+    def test_selftest_catches_the_seeded_hazards(self):
+        assert lockgraph.selftest(verbose=False) == 0
+
+    def test_abba_cycle_detected_via_api(self):
+        graph = lockgraph.install(wrap_all=True)
+        try:
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def order(first, second):
+                with first:
+                    with second:
+                        pass
+            for args in ((a, b), (b, a)):
+                t = threading.Thread(target=order, args=args)
+                t.start()
+                t.join()
+            rep = graph.report()
+            assert rep["cycles"], "ABBA ordering must form a cycle"
+            assert rep["cycle_edges"][0]["stack"]  # stacks captured
+        finally:
+            lockgraph.uninstall()
+
+    def test_same_site_instances_alias_to_a_self_edge_warning(self):
+        # lock identity is the CREATION SITE (lockdep-style): two
+        # instances born on one line share a node, so nesting them
+        # reports a self-edge warning, not a cycle — the documented
+        # granularity limitation (doc/design_analysis.md)
+        graph = lockgraph.install(wrap_all=True)
+        try:
+            a, b = threading.Lock(), threading.Lock()  # ONE line: one site
+            with a:
+                with b:
+                    pass
+            rep = graph.report()
+            assert not rep["cycles"]
+            assert rep["self_edge_warnings"]
+        finally:
+            lockgraph.uninstall()
+
+    def test_consistent_order_is_clean(self):
+        graph = lockgraph.install(wrap_all=True)
+        try:
+            a, b = threading.Lock(), threading.Lock()
+            for _ in range(3):
+                def nested():
+                    with a:
+                        with b:
+                            pass
+                t = threading.Thread(target=nested)
+                t.start()
+                t.join()
+            assert graph.report()["ok"]
+        finally:
+            lockgraph.uninstall()
+
+    def test_condition_wait_releases_in_held_set(self):
+        # a Condition wait must not leave the lock falsely 'held' — the
+        # waiter parks with the lock RELEASED, so a second thread taking
+        # (lock -> other) while the first is parked must not see
+        # phantom edges from the parked thread
+        graph = lockgraph.install(wrap_all=True)
+        try:
+            cond = threading.Condition()
+            woke = threading.Event()
+
+            def waiter():
+                with cond:
+                    woke.set()
+                    cond.wait(timeout=5.0)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            woke.wait(2.0)
+            # while the waiter is parked, its held-set must be empty
+            held_ids = [e for entries in graph._held.values()
+                        for e in entries]
+            deadline = 50
+            while held_ids and deadline:
+                import time as _t
+                _t.sleep(0.01)
+                deadline -= 1
+                held_ids = [e for entries in graph._held.values()
+                            for e in entries]
+            assert not held_ids, "parked waiter still marked holding"
+            with cond:
+                cond.notify_all()
+            t.join(5.0)
+        finally:
+            lockgraph.uninstall()
+
+    def test_put_to_self_hazard(self):
+        graph = lockgraph.install(wrap_all=True)
+        try:
+            q = queue.Queue(maxsize=8)
+            q.put(1)
+            q.get()
+            q.put(2)   # same thread consumes AND block-puts: hazard
+            assert any(h["kind"] == "put-to-self"
+                       for h in graph.report()["hazards"])
+        finally:
+            lockgraph.uninstall()
+
+
+# -- dogfood pins ------------------------------------------------------------
+
+
+class TestDogfood:
+    def test_the_repo_lints_clean(self):
+        result = run_lint(REPO_ROOT)
+        assert result.ok, "\n".join(f.render() for f in result.findings)
+        # every surviving suppression carries its reason by construction
+        assert all(s.reason for s in result.suppressions)
+
+    def test_analysis_package_imports_jax_and_numpy_free(self):
+        code = (
+            "import sys\n"
+            "import edl_tpu.analysis\n"
+            "import edl_tpu.analysis.lockgraph\n"
+            "import edl_tpu.analysis.core\n"
+            "import edl_tpu.analysis.checks\n"
+            "import edl_tpu.analysis.__main__\n"
+            "banned = [m for m in ('jax', 'numpy', 'flax', 'optax')"
+            " if m in sys.modules]\n"
+            "assert not banned, f'analysis pulled in {banned}'\n"
+            "print('PURE')\n")
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             cwd=REPO_ROOT, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "PURE" in out.stdout
+
+    def test_lint_cli_json_report(self, tmp_path):
+        out_json = tmp_path / "lint.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "edl_tpu.analysis", "lint",
+             "--root", REPO_ROOT, "--json", str(out_json)],
+            capture_output=True, text=True, cwd=REPO_ROOT, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(out_json.read_text())
+        assert doc["ok"] is True
+        assert set(doc["checks"]) == set(CHECKS)

@@ -468,7 +468,12 @@ def test_escape_hatch_restores_pure_polling(monkeypatch):
     b.release()
 
 
-# -- native tsan selftest (CI sequential step) -------------------------------
+# -- native sanitizer selftests (CI sequential steps) ------------------------
+# TSAN and ASan cannot share one binary, so the SAME watcher-churn
+# scenario runs against each sanitizer build: tsan catches lock/race
+# mistakes in the watch fan-out, asan+ubsan catches the memory half
+# (use-after-free of a cancelled watcher's queue state, OOB in the
+# frame codec, signed overflow in revision math).
 
 @pytest.fixture(scope="session")
 def tsan_binary():
@@ -479,25 +484,54 @@ def tsan_binary():
     return os.path.join(NATIVE_DIR, "edl-store-tsan")
 
 
+@pytest.fixture(scope="session")
+def asan_binary():
+    build = subprocess.run(["make", "-C", NATIVE_DIR, "asan"],
+                           capture_output=True, text=True)
+    if build.returncode != 0:
+        pytest.skip(f"asan build unavailable:\n{build.stderr[-500:]}")
+    return os.path.join(NATIVE_DIR, "edl-store-asan")
+
+
 @pytest.mark.slow
 def test_native_watch_selftest_tsan(tsan_binary, tmp_path):
     """Concurrent watchers churning against concurrent mutators + the
     sweeper, under ThreadSanitizer: the watcher registry and fan-out
     ride the store's mutation path, so any locking mistake in the new
     code is a data race this run aborts on (halt_on_error)."""
+    _watch_churn(tsan_binary, tmp_path,
+                 env={"TSAN_OPTIONS":
+                      "halt_on_error=1 exitcode=66 abort_on_error=0"},
+                 report_marker="WARNING: ThreadSanitizer")
+
+
+@pytest.mark.slow
+def test_native_watch_selftest_asan(asan_binary, tmp_path):
+    """The same churn under AddressSanitizer+UBSan: watcher churn
+    allocates/frees per-watcher queue state on the mutation path, so a
+    use-after-free or OOB there aborts the daemon mid-run."""
+    _watch_churn(asan_binary, tmp_path,
+                 env={"ASAN_OPTIONS":
+                      "halt_on_error=1 exitcode=66 abort_on_error=0",
+                      "UBSAN_OPTIONS": "halt_on_error=1 print_stacktrace=1"},
+                 report_marker="ERROR: AddressSanitizer",
+                 extra_markers=("runtime error:",))
+
+
+def _watch_churn(binary, tmp_path, *, env, report_marker,
+                 extra_markers=()):
     port = net.free_port()
-    log_path = tmp_path / "tsan-watch.log"
-    env = dict(os.environ,
-               TSAN_OPTIONS="halt_on_error=1 exitcode=66 abort_on_error=0")
+    log_path = tmp_path / "san-watch.log"
+    env = dict(os.environ, **env)
     proc = subprocess.Popen(
-        [tsan_binary, "--host", "127.0.0.1", "--port", str(port),
+        [binary, "--host", "127.0.0.1", "--port", str(port),
          "--sweep-interval", "0.01"],
         stdout=open(log_path, "ab"), stderr=subprocess.STDOUT, env=env)
     boot = StoreClient(f"127.0.0.1:{port}", timeout=10.0)
     deadline = time.time() + 20
     while time.time() < deadline and not boot.ping():
         time.sleep(0.1)
-    assert boot.ping(), "tsan daemon never came up"
+    assert boot.ping(), "sanitizer daemon never came up"
     boot.close()
 
     errors, stop = [], threading.Event()
@@ -542,10 +576,11 @@ def test_native_watch_selftest_tsan(tsan_binary, tmp_path):
     try:
         assert not errors, f"client errors (daemon died mid-run?): {errors}"
         assert proc.poll() is None, \
-            f"daemon exited {proc.returncode} — TSAN report:\n" \
+            f"daemon exited {proc.returncode} — sanitizer report:\n" \
             f"{log_path.read_bytes().decode(errors='replace')[-3000:]}"
     finally:
         proc.terminate()
         proc.wait(timeout=10)
     report = log_path.read_bytes().decode(errors="replace")
-    assert "WARNING: ThreadSanitizer" not in report, report[-3000:]
+    for marker in (report_marker, *extra_markers):
+        assert marker not in report, report[-3000:]
