@@ -1446,6 +1446,105 @@ def bench_control_plane(on_tpu: bool) -> dict:
     }
 
 
+def bench_store_ha(on_tpu: bool) -> dict:
+    """Replicated coordination store (ISSUE 11): the control plane
+    survives losing its leader.
+
+    One 3-replica group under a registry-shaped write stream with a
+    live watch consumer; the leader is CRASHED (no resign — failover
+    pays the real lease-expiry price):
+      - store_failover_downtime_ms: last acked write before the kill ->
+        first acked write after (the write-unavailability window;
+        election TTL 0.6s dominates it);
+      - store_events_lost: majority-acked writes missing from the
+        revision-audited watch stream after resume-by-revision — the
+        acceptance gate, MUST be 0;
+      - store_watch_fanout_streams: concurrent watch streams a single
+        FOLLOWER served during the run (fan-out rides followers, so
+        watch capacity scales with replicas, not with the leader).
+    Host-side control plane: identical on every platform. The deeper
+    sweep (thousands of pods, hundreds of streams, single-vs-majority
+    write cost) lives in tools/store_bench.py."""
+    del on_tpu
+    import threading
+
+    from edl_tpu.coord.client import StoreClient
+    from edl_tpu.coord.replication import ReplicaGroup
+
+    fanout_streams = 64
+    with ReplicaGroup(3, election_ttl=0.6) as group:
+        leader = group.wait_leader(timeout=20.0)
+        follower = next(s for s in group.servers if s is not leader)
+        client = group.client(timeout=3.0)
+        watcher = StoreClient(follower.endpoint, timeout=3.0)
+        watch = watcher.watch("/job/", start_revision=0)
+        fan = [follower.node.store.watch("/job/")
+               for _ in range(fanout_streams)]
+
+        acked: dict[str, int] = {}
+        stop = threading.Event()
+        gap = {"last_before": 0.0, "first_after": None}
+        killed = {"at": None}
+
+        def writer() -> None:
+            i = 0
+            while not stop.is_set() and i < 1500:
+                try:
+                    rev = client.put(f"/job/rank/{i % 16}", f"p-{i}")
+                    now = time.perf_counter()
+                    acked[f"p-{i}"] = rev
+                    if killed["at"] is None:
+                        gap["last_before"] = now
+                    elif gap["first_after"] is None:
+                        gap["first_after"] = now
+                except Exception:  # noqa: BLE001 — window measured below
+                    pass
+                i += 1
+                time.sleep(0.01)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            time.sleep(0.5)
+            killed["at"] = time.perf_counter()
+            group.kill_leader()
+            group.wait_leader(timeout=20.0)
+            deadline = time.monotonic() + 15.0
+            while gap["first_after"] is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.3)
+        finally:
+            stop.set()
+            t.join(timeout=15.0)
+
+        seen: set[int] = set()
+        deadline = time.monotonic() + 10.0
+        max_acked = max(acked.values(), default=0)
+        while time.monotonic() < deadline:
+            batch = watch.get(timeout=0.5)
+            if batch is None:
+                if seen and max(seen) >= max_acked:
+                    break
+                continue
+            seen.update(ev.revision for ev in batch.events)
+        lost = sum(1 for rev in acked.values() if rev not in seen)
+        for w in fan:
+            w.cancel()
+        watch.cancel()
+        watcher.close()
+        client.close()
+    downtime_ms = 0.0
+    if gap["first_after"] is not None:
+        downtime_ms = (gap["first_after"] - gap["last_before"]) * 1e3
+    return {
+        "store_failover_downtime_ms": round(downtime_ms, 1),
+        "store_events_lost": lost,
+        "store_failover_acked_writes": len(acked),
+        "store_watch_fanout_streams": fanout_streams + 1,
+    }
+
+
 def distill_quality_extras() -> dict:
     """Surface the flagship distill QUALITY measurement (the reference's
     acc1 77.1->79.0 story) from the newest committed artifact —
@@ -1487,6 +1586,7 @@ def main() -> None:
     scaler = bench_scaler(on_tpu)
     serving_slo = bench_serving_slo(on_tpu)
     control_plane = bench_control_plane(on_tpu)
+    store_ha = bench_store_ha(on_tpu)
     cores_to_feed_jpeg = (resnet["imgs_per_sec"]
                           / max(loader["imgs_per_sec_per_core"], 1e-9))
     # the headline feed question, recomputed against the packed +
@@ -1628,6 +1728,10 @@ def main() -> None:
             # watch-mode (same consumer set), and the scaler's
             # fresh-util -> decision reaction vs its fallback interval
             **control_plane,
+            # replicated store HA: leader-kill failover window +
+            # zero-lost-events audit + follower watch fan-out
+            # (tools/store_bench.py has the load sweep)
+            **store_ha,
             # flagship distill QUALITY (committed artifact; see
             # tools/distill_quality_tpu.py)
             **distill_quality_extras(),

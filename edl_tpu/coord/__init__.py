@@ -10,11 +10,16 @@ from edl_tpu.coord.consistent_hash import ConsistentHash
 
 
 def __getattr__(name):
-    # Lazy so `python -m edl_tpu.coord.server` doesn't import the module
-    # twice (runpy RuntimeWarning).
+    # Lazy so `python -m edl_tpu.coord.server` / `.replication` don't
+    # import their module twice (runpy RuntimeWarning).
     if name == "StoreServer":
         from edl_tpu.coord.server import StoreServer
         return StoreServer
+    if name in ("ReplicaNode", "ReplicaServer", "ReplicaGroup",
+                "ShardedStoreClient", "ShardRouter", "shard_key",
+                "parse_topology"):
+        from edl_tpu.coord import replication
+        return getattr(replication, name)
     raise AttributeError(name)
 
 __all__ = [
@@ -38,4 +43,11 @@ __all__ = [
     "ConsistentHash",
     "Collector",
     "UtilizationPublisher",
+    "ReplicaNode",
+    "ReplicaServer",
+    "ReplicaGroup",
+    "ShardedStoreClient",
+    "ShardRouter",
+    "shard_key",
+    "parse_topology",
 ]

@@ -6,6 +6,20 @@ reference gets from swapping etcd/in-mem stores, pkg/master/inmem_store.go).
 
 Reconnect-on-error with bounded retries mirrors the reference's etcd wrapper
 decorator (discovery/etcd_client.py:40-49).
+
+r17 (replicated store): the ``endpoint`` argument accepts a
+comma-joined replica list ("h0:p,h1:p,h2:p"). The client talks to one
+endpoint at a time and fails over transparently: transport errors
+rotate to the next replica under the shared jittered-exponential
+``Backoff`` (utils/backoff.py — the same schedule the watch reconnect
+uses, so a leader kill does not produce a synchronized retry herd), a
+``not_leader`` refusal re-targets the named leader (or rotates until
+the new leader emerges from election), and a shard ``redirect`` refusal
+follows the owning group's endpoints. Refusals are safe for ALL ops
+including put_if_absent/cas — a refusing server did not apply the op —
+while transport errors keep the old ambiguity rules. Hinted hops are
+bounded (``EDL_TPU_STORE_REDIRECT_HOPS``) so a misconfigured topology
+surfaces as a clear "redirect loop" error instead of a hang.
 """
 
 from __future__ import annotations
@@ -18,7 +32,8 @@ from collections import deque
 
 from edl_tpu.coord import wire
 from edl_tpu.coord.store import Event, Record, Store, Watch, WatchBatch
-from edl_tpu.utils import exceptions
+from edl_tpu.utils import config, exceptions
+from edl_tpu.utils.backoff import Backoff
 from edl_tpu.utils.exceptions import EdlStoreError
 from edl_tpu.utils.logging import get_logger
 from edl_tpu.utils.net import split_endpoint
@@ -40,28 +55,93 @@ def _typed_error(message: str) -> EdlStoreError:
 
 class StoreClient(Store):
     def __init__(self, endpoint: str, timeout: float = 5.0,
-                 connect_retries: int = 30, retry_interval: float = 0.3):
-        self._endpoint = endpoint
+                 connect_retries: int = 30, retry_interval: float = 0.3,
+                 max_hops: int | None = None):
+        eps = [e for e in (p.strip() for p in endpoint.split(",")) if e]
+        if not eps:
+            raise EdlStoreError("empty store endpoint list")
+        self._endpoint = ",".join(eps)  # display / compat
         self._timeout = timeout
         self._connect_retries = connect_retries
         self._retry_interval = retry_interval
+        self._max_hops = max_hops if max_hops is not None \
+            else max(1, config.env_int("EDL_TPU_STORE_REDIRECT_HOPS", 4))
+        self._backoff_base = config.env_float(
+            "EDL_TPU_STORE_FAILOVER_BACKOFF", retry_interval)
+        # endpoint-order state has its own small lock so the watch
+        # reader thread can pick a dial target while a request holds
+        # the main op lock
+        self._ep_lock = threading.Lock()
+        self._endpoints = eps          # guarded-by: _ep_lock
+        self._cursor = 0               # guarded-by: _ep_lock
+        self._preferred: str | None = None  # guarded-by: _ep_lock
         self._lock = threading.Lock()
-        self._sock: socket.socket | None = None
+        self._sock: socket.socket | None = None  # guarded-by: _lock
 
     # -- connection management --------------------------------------------
 
+    def _candidates(self) -> list[str]:
+        """Dial order: the leader hint first, then the replica list
+        rotated so the most recently working endpoint leads."""
+        with self._ep_lock:
+            eps = self._endpoints[self._cursor:] \
+                + self._endpoints[:self._cursor]
+            if self._preferred is not None:
+                eps = [self._preferred] + [e for e in eps
+                                           if e != self._preferred]
+            return eps
+
+    def _note_connected(self, endpoint: str) -> None:
+        with self._ep_lock:
+            if endpoint in self._endpoints:
+                self._cursor = self._endpoints.index(endpoint)
+
+    def _set_preferred(self, endpoint: str) -> None:
+        """Leader hint from a not_leader refusal; unknown endpoints are
+        learned (the hint may name a replica added after this client
+        was configured)."""
+        with self._ep_lock:
+            if endpoint not in self._endpoints:
+                self._endpoints.append(endpoint)
+            self._preferred = endpoint
+
+    def _rotate(self) -> None:
+        with self._ep_lock:
+            self._preferred = None
+            self._cursor = (self._cursor + 1) % len(self._endpoints)
+
+    def _retarget(self, endpoints: list[str]) -> None:
+        """Shard REDIRECT: this client now talks to the owning group."""
+        eps = [e for e in endpoints if e]
+        if not eps:
+            return
+        with self._ep_lock:
+            self._endpoints = eps
+            self._cursor = 0
+            self._preferred = None
+
     def _connect(self) -> socket.socket:
-        host, port = split_endpoint(self._endpoint)
         last: Exception | None = None
+        backoff = Backoff(base=self._retry_interval,
+                          max_delay=self._retry_interval * 2)
         for _ in range(self._connect_retries):
-            try:
-                sock = socket.create_connection((host, port), timeout=self._timeout)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                return sock
-            except OSError as exc:
-                last = exc
-                time.sleep(self._retry_interval)
-        raise EdlStoreError(f"cannot connect to store at {self._endpoint}: {last}")
+            for ep in self._candidates():
+                try:
+                    sock = socket.create_connection(
+                        split_endpoint(ep), timeout=self._timeout)
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    self._note_connected(ep)
+                    return sock
+                except OSError as exc:
+                    last = exc
+                    with self._ep_lock:
+                        if self._preferred == ep:
+                            # a leader hint that does not even accept a
+                            # connection is stale — stop chasing it
+                            self._preferred = None
+            backoff.sleep()
+        raise EdlStoreError(
+            f"cannot connect to store at {self._endpoint}: {last}")
 
     # Ops safe to re-send after a connection error. Mutating-but-idempotent
     # ops (put/delete) are included: re-applying them yields the same state.
@@ -69,6 +149,8 @@ class StoreClient(Store):
     # the response lost, and a blind resend would report the wrong outcome
     # (e.g. a rank claim that succeeded looking lost). Those surface an
     # EdlStoreError and the caller decides (e.g. read back ownership).
+    # (Structured REFUSALS — not_leader / redirect — are different: the
+    # server answered without applying, so every op may re-route.)
     _RETRYABLE = frozenset({
         "get", "get_prefix", "events_since", "ping", "lease_keepalive",
         "put", "delete", "delete_prefix", "lease_revoke", "lease_grant",
@@ -77,35 +159,79 @@ class StoreClient(Store):
     def _call(self, **req) -> dict:
         retryable = req.get("op") in self._RETRYABLE
         with self._lock:
-            attempts = 2 if retryable else 1
-            for attempt in range(1, attempts + 1):
+            transport_errors = 0
+            hinted_hops = 0
+            blind_rounds = 0
+            last_hint: str | None = None
+            failover = Backoff(base=self._backoff_base,
+                               max_delay=max(1.0, self._backoff_base * 8))
+            while True:
                 if self._sock is None:
                     self._sock = self._connect()
                 try:
                     wire.send_msg(self._sock, req)
                     resp = wire.recv_msg(self._sock)
-                    break
                 except (OSError, wire.WireError) as exc:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                    if attempt == attempts:
+                    self._drop_sock()
+                    transport_errors += 1
+                    if transport_errors >= (2 if retryable else 1):
                         raise EdlStoreError(
-                            f"store rpc {req.get('op')} failed: {exc}") from exc
-            if not resp.get("ok"):
+                            f"store rpc {req.get('op')} failed: {exc}"
+                        ) from exc
+                    self._rotate()
+                    continue
+                if resp.get("ok"):
+                    return resp
+                if resp.get("redirect"):
+                    # shard refusal: definitively not applied — follow
+                    # the owner group, bounded (a loop here means the
+                    # servers disagree about the topology, not a
+                    # transient to wait out)
+                    self._drop_sock()
+                    hinted_hops += 1
+                    if hinted_hops > self._max_hops:
+                        raise EdlStoreError(
+                            f"store rpc {req.get('op')}: redirect loop "
+                            f"({hinted_hops} hops ending at "
+                            f"{self._endpoint}) — shard topology "
+                            "disagrees between servers; check "
+                            "EDL_TPU_STORE_ENDPOINTS groups")
+                    self._retarget(resp.get("endpoints") or ())
+                    continue
+                if resp.get("not_leader"):
+                    # leadership refusal: not applied. A FRESH hint is
+                    # followed immediately; a repeated/absent hint means
+                    # failover is in flight — rotate + jittered backoff
+                    # until the new leader emerges (bounded like the
+                    # connect budget, so "no quorum" is an error, not a
+                    # hang).
+                    self._drop_sock()
+                    blind_rounds += 1
+                    if blind_rounds > self._connect_retries:
+                        raise EdlStoreError(
+                            f"store rpc {req.get('op')}: no leader "
+                            f"emerged among {self._endpoint}")
+                    hint = resp.get("leader")
+                    if hint and hint != last_hint:
+                        last_hint = hint
+                        self._set_preferred(hint)
+                        continue
+                    self._rotate()
+                    failover.sleep()
+                    continue
                 raise _typed_error(resp.get("error", "unknown store error"))
-            return resp
+
+    def _drop_sock(self) -> None:  # holds-lock: _lock
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def close(self) -> None:
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+            self._drop_sock()
 
     # -- Store API ---------------------------------------------------------
 
@@ -167,6 +293,11 @@ class StoreClient(Store):
         except EdlStoreError:
             return False
 
+    def status(self) -> dict:
+        """Role/term/leader/revision of the endpoint currently talked
+        to (leader discovery + the bench's failover probes)."""
+        return self._call(op="status")
+
 
 class ClientWatch(Watch):
     """Client half of a watch stream: dedicated socket + reader thread.
@@ -185,7 +316,11 @@ class ClientWatch(Watch):
         self._client = client
         self.prefix = prefix
         self._heartbeat = heartbeat
-        self._backoff = reconnect_backoff
+        # shared jittered-exponential schedule (utils/backoff.py): a
+        # fleet of watchers re-attaching after a leader kill must not
+        # re-dial in lockstep
+        self._backoff = Backoff(base=reconnect_backoff,
+                                max_delay=max(1.0, reconnect_backoff * 10))
         self._last_rev = start_revision  # None until the first ack
         self.created_revision = start_revision or 0
         self._cond = threading.Condition()
@@ -211,11 +346,12 @@ class ClientWatch(Watch):
 
     def _run(self) -> None:
         first = True
+        redirect_hops = 0
         while not self._stop.is_set():
             try:
                 sock = self._client._connect()
             except EdlStoreError:
-                if self._stop.wait(max(self._backoff, 1.0)):
+                if self._backoff.sleep(self._stop):
                     return
                 continue
             with self._cond:
@@ -232,11 +368,24 @@ class ClientWatch(Watch):
                 sock.settimeout(max(1.0, self._heartbeat * 5))
                 ack = wire.recv_msg(sock)
                 if not (ack.get("ok") and ack.get("watching")):
+                    if ack.get("redirect") or ack.get("not_leader"):
+                        # routing refusal, not "op unsupported": follow
+                        # the shard owner / another replica — bounded,
+                        # so disagreeing servers surface as rejection
+                        redirect_hops += 1
+                        if redirect_hops <= self._client._max_hops:
+                            if ack.get("redirect") and ack.get("endpoints"):
+                                self._client._retarget(ack["endpoints"])
+                            else:
+                                self._client._rotate()
+                            continue
                     # an explicit refusal is permanent (op unsupported):
                     # surface it instead of reconnect-looping forever
                     self._rejected = str(ack.get("error", ack))
                     self._ready.set()
                     return
+                redirect_hops = 0
+                self._backoff.reset()
                 if self._last_rev is None:
                     self._last_rev = int(ack["revision"])
                     self.created_revision = self._last_rev
@@ -265,7 +414,8 @@ class ClientWatch(Watch):
                     sock.close()
                 except OSError:
                     pass
-            self._stop.wait(self._backoff)
+            if self._backoff.sleep(self._stop):
+                return
 
     def _push(self, batch: WatchBatch) -> None:
         with self._cond:

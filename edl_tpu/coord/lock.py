@@ -164,6 +164,18 @@ class DistributedLock:
 
     # -- release ------------------------------------------------------------
 
+    def abandon(self) -> None:
+        """Crash simulation (chaos tests): stop the keepalive WITHOUT
+        revoking the lease, so the lock frees itself only when the TTL
+        runs out — exactly what a killed holder's lock does. The hold
+        is forgotten locally; a later try_acquire campaigns fresh."""
+        hold, self._hold = self._hold, None
+        if hold is None:
+            return
+        hold.stop.set()
+        if hold.keeper is not None:
+            hold.keeper.join(timeout=2)
+
     def release(self) -> None:
         hold, self._hold = self._hold, None
         if hold is None:

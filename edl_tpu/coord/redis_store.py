@@ -460,9 +460,20 @@ class RedisWatch(Watch):
 
 
 def connect_store(endpoint: str, timeout: float = 10.0) -> Store:
-    """Store from an endpoint string: `redis://host:port` -> RedisStore,
-    bare `host:port` -> the edl store client (the default)."""
+    """Store from an endpoint string — every consumer's connection path.
+
+    - ``redis://host:port`` -> RedisStore (discovery flavor);
+    - ``h0:p,h1:p,h2:p`` -> StoreClient over the replica list
+      (transparent leader failover within the group);
+    - ``g0=h0:p,h1:p;g1=h2:p,...`` (or a flat list with
+      ``EDL_TPU_STORE_SHARDS`` > 1) -> ShardedStoreClient routing
+      registry prefixes across replica groups.
+    """
     if endpoint.startswith("redis://"):
         return RedisStore(endpoint[len("redis://"):], timeout=timeout)
+    from edl_tpu.utils import config
+    if ";" in endpoint or config.env_int("EDL_TPU_STORE_SHARDS", 1) > 1:
+        from edl_tpu.coord.replication import ShardedStoreClient
+        return ShardedStoreClient(endpoint, timeout=timeout)
     from edl_tpu.coord.client import StoreClient
     return StoreClient(endpoint, timeout=timeout)

@@ -28,6 +28,7 @@ log = get_logger("edl_tpu.coord.server")
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         store: InMemStore = self.server.store  # type: ignore[attr-defined]
+        node = getattr(self.server, "node", None)  # replication plane
         sock: socket.socket = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         while True:
@@ -35,15 +36,29 @@ class _Handler(socketserver.BaseRequestHandler):
                 req = wire.recv_msg(sock)
             except (wire.WireError, OSError):
                 return
-            if req.get("op") == "watch":
+            resp = None
+            if node is not None:
+                # The replica node owns routing: shard REDIRECTs,
+                # follower NOT_LEADER refusals, peer replication ops and
+                # leader commit-waits all happen here. None means "serve
+                # from the local store as usual" (reads, watches, and
+                # everything on a standalone server).
+                try:
+                    resp = node.intercept(req)
+                except Exception as exc:  # noqa: BLE001 — surface it
+                    resp = {"ok": False,
+                            "error": f"{type(exc).__name__}: {exc}"}
+            if resp is None and req.get("op") == "watch":
                 # long-lived: the connection becomes a push stream and
                 # ends when the client disconnects or the server stops
                 self._serve_watch(store, sock, req, self.server)
                 return
-            try:
-                resp = self._dispatch(store, req)
-            except Exception as exc:  # surface the error to the client
-                resp = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+            if resp is None:
+                try:
+                    resp = self._dispatch(store, req)
+                except Exception as exc:  # surface the error to the client
+                    resp = {"ok": False,
+                            "error": f"{type(exc).__name__}: {exc}"}
             try:
                 wire.send_msg(sock, resp)
             except OSError:
@@ -140,6 +155,12 @@ class _Handler(socketserver.BaseRequestHandler):
                     "events": [[e.type, e.key, e.value, e.revision] for e in evs]}
         if op == "ping":
             return {"ok": True}
+        if op == "status":
+            # replicated nodes intercept this with role/term/leader
+            # detail; a standalone server answers enough for a client's
+            # leader probe to conclude "just use me"
+            return {"ok": True, "role": "standalone", "leader": None,
+                    "term": 0, "revision": store.current_revision}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
 
@@ -152,10 +173,13 @@ class StoreServer:
     """In-process handle: start/stop a store server on a port."""
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
-                 store: InMemStore | None = None, sweep_interval: float = 0.5):
+                 store: InMemStore | None = None, sweep_interval: float = 0.5,
+                 node=None):
         self.store = store or InMemStore()
+        self.node = node  # replication plane (coord/replication.py) or None
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.store = self.store  # type: ignore[attr-defined]
+        self._server.node = node  # type: ignore[attr-defined]
         self._server.active_watches = set()  # type: ignore[attr-defined]
         self._server.watch_lock = threading.Lock()  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
@@ -177,6 +201,10 @@ class StoreServer:
     def _sweeper(self) -> None:
         while not self._stop.wait(self._sweep_interval):
             self.store.sweep()
+            if self.node is not None:
+                # the election sidecar store must keep expiring leases
+                # even while the data store is a passive follower
+                self.node.sweep()
 
     def stop(self) -> None:
         self._stop.set()

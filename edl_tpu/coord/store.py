@@ -267,6 +267,9 @@ class Store:
 
 _MAX_EVENTS = 4096
 _MAX_WATCH_PENDING = 4096
+# ttl given to a lease resurrected from replicated records alone (its
+# grant entry predates the follower's catch-up window)
+_DEFAULT_LEASE_TTL = 10.0
 
 
 class InMemStore(Store):
@@ -287,6 +290,11 @@ class InMemStore(Store):
         # public Store-API calls served (bench: poll- vs watch-mode
         # request volume); watch deliveries are pushes, not requests
         self.op_count = 0                     # guarded-by: _lock
+        # Passive mode (replication followers): lease expiry is the
+        # LEADER's decision, shipped here as replicated DELETE events —
+        # a follower that also expired locally would double-delete with
+        # revisions the leader never assigned.
+        self._passive = False                 # guarded-by: _lock
 
     # -- internals ---------------------------------------------------------
 
@@ -305,6 +313,8 @@ class InMemStore(Store):
                 watcher._push(ev)
 
     def _expire(self) -> None:  # holds-lock: _lock
+        if self._passive:
+            return
         now = self._clock()
         dead = [l for l in self._leases.values() if l.deadline <= now]
         for lease in dead:
@@ -491,3 +501,128 @@ class InMemStore(Store):
     def watcher_count(self) -> int:
         with self._lock:
             return len(self._watchers)
+
+    # -- replication raw-apply (coord/replication.py) ------------------------
+    #
+    # Followers mirror the leader's mutation log verbatim: the leader
+    # assigned the revisions, so the apply path takes them as given
+    # instead of minting new ones, never runs lease expiry (passive
+    # mode), and still fans events out to local watchers — which is what
+    # lets a follower serve reads and revision-resumable watch streams.
+
+    def set_passive(self, passive: bool) -> None:
+        """Follower mode on/off. Entering active (leader) mode rebuilds
+        the lease->keys index from the records themselves (replicated
+        PUTs carry the lease id) and restarts every lease's clock at
+        now+ttl: the new leader cannot know how much TTL was left on the
+        old leader's clock, so it gives every lease one full period —
+        live owners keepalive long before that, dead owners expire one
+        TTL late at worst (never early, which is the dangerous side)."""
+        with self._lock:
+            if self._passive == passive:
+                return
+            self._passive = passive
+            if not passive:
+                now = self._clock()
+                for lease in self._leases.values():
+                    lease.keys.clear()
+                    lease.deadline = now + lease.ttl
+                for key, rec in self._data.items():
+                    if rec.lease:
+                        entry = self._leases.get(rec.lease)
+                        if entry is None:
+                            # grant entry lost in catch-up (only its keys
+                            # replicated): resurrect with a default ttl —
+                            # the owner's keepalive re-arms it
+                            entry = _Lease(rec.lease, _DEFAULT_LEASE_TTL,
+                                           now + _DEFAULT_LEASE_TTL)
+                            self._leases[rec.lease] = entry
+                            self._next_lease = max(self._next_lease,
+                                                   rec.lease + 1)
+                        entry.keys.add(key)
+
+    def apply_put(self, key: str, value: str, revision: int,
+                  lease: int = 0) -> None:
+        """Replicated PUT at the leader's revision (idempotent: a replay
+        at or below the applied revision is a no-op)."""
+        with self._lock:
+            if revision <= self._revision:
+                return
+            old = self._data.get(key)
+            if old is not None:
+                self._detach(key, old)
+            self._data[key] = Record(key, value, revision, lease)
+            if lease:
+                entry = self._leases.get(lease)
+                if entry is None:
+                    entry = _Lease(lease, _DEFAULT_LEASE_TTL,
+                                   self._clock() + _DEFAULT_LEASE_TTL)
+                    self._leases[lease] = entry
+                self._next_lease = max(self._next_lease, lease + 1)
+                entry.keys.add(key)
+            self._revision = max(self._revision, revision)
+            self._emit(Event("PUT", key, value, revision))
+
+    def apply_delete(self, key: str, value: str, revision: int) -> None:
+        """Replicated DELETE (lease expiry on the leader arrives here
+        too — it is just a DELETE event in the log)."""
+        with self._lock:
+            if revision <= self._revision:
+                return
+            rec = self._data.pop(key, None)
+            if rec is not None:
+                self._detach(key, rec)
+            self._revision = max(self._revision, revision)
+            self._emit(Event("DELETE", key, value, revision))
+
+    def apply_lease(self, lease_id: int, ttl: float) -> None:
+        """Replicated lease grant/keepalive: (re)arm the follower-side
+        deadline from ITS clock. Deadlines only matter after promotion
+        (set_passive(False) re-bases them anyway); tracking them here
+        keeps the table warm and the id counter monotonic."""
+        with self._lock:
+            entry = self._leases.get(lease_id)
+            if entry is None:
+                entry = _Lease(lease_id, ttl, 0.0)
+                self._leases[lease_id] = entry
+            entry.ttl = ttl
+            entry.deadline = self._clock() + ttl
+            self._next_lease = max(self._next_lease, lease_id + 1)
+
+    def apply_lease_gone(self, lease_id: int) -> None:
+        """Replicated revoke/expiry: the key DELETEs ride the event log
+        separately; this only drops the table entry."""
+        with self._lock:
+            self._leases.pop(lease_id, None)
+
+    def snapshot_state(self) -> dict:
+        """Full-state document for follower catch-up when the event
+        history no longer covers its revision (see install_snapshot)."""
+        with self._lock:
+            return {
+                "revision": self._revision,
+                "records": [[r.key, r.value, r.revision, r.lease]
+                            for r in self._data.values()],
+                "leases": [[l.id, l.ttl] for l in self._leases.values()],
+            }
+
+    def install_snapshot(self, doc: dict) -> None:
+        """Replace local state wholesale (lagging or divergent follower).
+        Event history before the snapshot revision is gone by
+        construction, so every local watcher gets an explicit
+        ``compacted`` batch — the same resync contract as history
+        compaction; a watch consumer cannot tell the difference and
+        does not need to."""
+        with self._lock:
+            self._data = {r[0]: Record(r[0], r[1], r[2], r[3])
+                          for r in doc.get("records", ())}
+            self._leases = {}
+            now = self._clock()
+            for lease_id, ttl in doc.get("leases", ()):
+                self._leases[lease_id] = _Lease(lease_id, ttl, now + ttl)
+                self._next_lease = max(self._next_lease, lease_id + 1)
+            self._revision = max(self._revision, int(doc.get("revision", 0)))
+            self._events = []
+            self._first_event_rev = self._revision + 1
+            for watcher in self._watchers:
+                watcher._push_compacted(self._revision)

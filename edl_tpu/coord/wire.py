@@ -25,6 +25,26 @@ watcher queue): the client must resync with ``get_prefix`` and may resume
 from that frame's revision. There is no cancel op — the client closes the
 connection. The full resume/compaction contract is doc/design_coord.md.
 
+Replicated topologies (coord/replication.py) add two structured refusal
+shapes on top of the ``{"ok": false}`` envelope — refusals, not
+transport errors, so the op was definitively NOT applied and a client
+may re-route even non-idempotent ops (put_if_absent/cas) safely:
+
+    {"ok": false, "not_leader": true, "leader": "host:port" | null,
+     "error": "..."}                       # write sent to a follower;
+                                           # `leader` is a routing hint
+    {"ok": false, "redirect": true, "group": str,
+     "endpoints": ["host:port", ...], "error": "..."}
+                                           # key owned by another shard
+                                           # group (SURVEY C3's REDIRECT)
+
+Replica peers also exchange ``repl_probe`` / ``repl_append`` /
+``repl_snapshot`` / ``status`` ops over the same frames (schema in
+coord/replication.py). ``elect_space: true`` on a request routes it to
+the replica's ALWAYS-ACTIVE election sidecar store instead of the
+replicated data store — the election substrate must keep expiring
+leases while the data store is a passive follower.
+
 (The reference's redis balancer path uses an analogous hand-rolled framed
 protocol: distill/redis/balance_server.py:27-32. Ours differs in magic,
 framing and message schema by design.)

@@ -1,0 +1,59 @@
+"""Jittered exponential backoff — the one retry cadence for the repo.
+
+Before r17 every reconnecting path rolled its own delay: StoreClient's
+connect loop slept a fixed `retry_interval`, ClientWatch re-dialed on a
+flat `reconnect_backoff`, and the registry's re-register loop used a
+bare 0.5 s wait. Fixed cadences synchronize: when a store leader dies,
+every client in the fleet retries on the same beat and the new leader
+eats a thundering herd exactly when it is busiest. This helper is the
+shared alternative: exponential growth with full jitter (delay drawn
+uniformly from [base, current]), reset on success.
+
+Pure stdlib; deterministic when constructed with a seeded ``rng`` (the
+selftests do this — wall-clock randomness in a test is a flake).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+
+class Backoff:
+    """One retry schedule: ``delay()`` returns the next jittered delay
+    and advances the window; ``reset()`` on success; ``sleep(stop)``
+    combines delay + interruptible wait.
+
+    Not thread-safe by design — each retry loop owns its instance
+    (sharing one schedule across threads would couple their cadences,
+    which is the herd this class exists to break).
+    """
+
+    def __init__(self, base: float = 0.2, factor: float = 2.0,
+                 max_delay: float = 5.0,
+                 rng: random.Random | None = None):
+        self.base = max(1e-3, base)
+        self.factor = factor
+        self.max_delay = max(self.base, max_delay)
+        self._rng = rng or random.Random()
+        self._current = self.base
+
+    def delay(self) -> float:
+        """Next delay: uniform over [base, current], then grow the
+        window (full jitter — AWS-style decorrelation without the
+        unbounded tail)."""
+        d = self._rng.uniform(self.base, self._current)
+        self._current = min(self.max_delay, self._current * self.factor)
+        return d
+
+    def reset(self) -> None:
+        self._current = self.base
+
+    def sleep(self, stop: threading.Event | None = None) -> bool:
+        """Wait out the next delay; True means `stop` fired (caller
+        should exit its retry loop, not retry again)."""
+        d = self.delay()
+        if stop is None:
+            threading.Event().wait(d)
+            return False
+        return stop.wait(d)

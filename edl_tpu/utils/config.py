@@ -43,7 +43,16 @@ ENV_VARS: dict[str, str] = {
     "EDL_TPU_COORDINATOR": "jax distributed coordinator endpoint",
     "EDL_TPU_CLUSTER_JSON": "serialized Cluster doc handed to trainers",
     "EDL_TPU_CLUSTER_VERSION": "cluster generation the trainer launched into",
-    "EDL_TPU_STORE_ENDPOINTS": "coordination store endpoints (comma-joined)",
+    "EDL_TPU_STORE_ENDPOINTS": "coordination store endpoints: replicas "
+                               "comma-joined, shard groups ;-separated",
+    "EDL_TPU_STORE_ELECTION_TTL": "store replica quorum-lease TTL seconds "
+                                  "(the failover detection horizon)",
+    "EDL_TPU_STORE_FAILOVER_BACKOFF": "client failover backoff base seconds "
+                                      "(jittered-exponential)",
+    "EDL_TPU_STORE_SHARDS": "shard-group count when splitting a flat "
+                            "replica list",
+    "EDL_TPU_STORE_REDIRECT_HOPS": "bound on hinted NOT_LEADER/REDIRECT "
+                                   "hops before erroring",
     "EDL_TPU_NODES_RANGE": "elastic node range 'min:max'",
     "EDL_TPU_NPROC_PERNODE": "trainer processes per node (0 = auto)",
     "EDL_TPU_UP_LIMIT_NODES": "hard ceiling on world growth",

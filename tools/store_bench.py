@@ -1,0 +1,240 @@
+"""Control-plane load bench for the replicated coordination store.
+
+Answers the three capacity questions ROADMAP item 1 frames, on the
+repo's 1-core bench host (numbers are PER CORE — the store fans out
+with cores, so fleet projections multiply):
+
+- **registration pressure**: how many simulated pods/second a
+  3-replica group absorbs through the full majority-ack write path
+  (TCP + replication + commit gate), vs the single-store baseline —
+  i.e. what one shard group costs vs what it buys;
+- **watch fan-out**: how many concurrent watch streams one follower
+  sustains while delivering a mutation burst to ALL of them (in-proc
+  streams measure the store's fan-out ceiling; a TCP cohort rides on
+  top to price the socket path);
+- **failover**: leader killed under load — write-unavailability window
+  and the zero-lost-events check (revision audit, same contract the
+  chaos dryrun enforces).
+
+    python tools/store_bench.py [--pods 2000] [--streams 500] [--json out.json]
+
+Prints a human summary and (with --json) the artifact consumed by
+`bench.py bench_store_ha`'s trend row. Pure control plane: identical
+on every platform, no jax anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/store_bench.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
+
+
+def bench_registrations(pods: int) -> dict:
+    """Pod registrations/s: single store vs 3-replica majority-ack."""
+    from edl_tpu.coord.client import StoreClient
+    from edl_tpu.coord.replication import ReplicaGroup
+    from edl_tpu.coord.server import StoreServer
+
+    def _drive(client, n) -> float:
+        t0 = time.perf_counter()
+        for i in range(n):
+            client.put(f"/job/pods/pod-{i}", '{"rank": %d}' % i)
+        return n / (time.perf_counter() - t0)
+
+    with StoreServer(port=0, host="127.0.0.1") as srv:
+        single = StoreClient(f"127.0.0.1:{srv.port}")
+        single_rate = _drive(single, pods)
+        single.close()
+    with ReplicaGroup(3, election_ttl=1.0) as group:
+        group.wait_leader(timeout=20.0)
+        client = group.client(timeout=5.0)
+        replicated_rate = _drive(client, pods)
+        client.close()
+    return {
+        "store_pods_registered": pods,
+        "store_single_writes_per_sec_core": round(single_rate, 1),
+        "store_majority_writes_per_sec_core": round(replicated_rate, 1),
+        "store_replication_write_cost_x": round(
+            single_rate / max(replicated_rate, 1e-9), 2),
+    }
+
+
+def bench_watch_fanout(streams: int, tcp_streams: int) -> dict:
+    """Follower watch fan-out: `streams` in-proc watchers plus a
+    `tcp_streams` TCP cohort on ONE follower, one mutation burst,
+    everyone must see every event."""
+    from edl_tpu.coord.client import StoreClient
+    from edl_tpu.coord.replication import ReplicaGroup
+
+    burst = 50
+    with ReplicaGroup(3, election_ttl=1.0) as group:
+        leader = group.wait_leader(timeout=20.0)
+        follower = next(s for s in group.servers if s is not leader)
+        client = group.client(timeout=5.0)
+        client.put("/fan/warm", "0")
+        deadline = time.monotonic() + 10.0
+        while follower.node.store.current_revision < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+        watches = [follower.node.store.watch("/fan/")
+                   for _ in range(streams)]
+        tcp_clients = [StoreClient(follower.endpoint, timeout=5.0)
+                       for _ in range(tcp_streams)]
+        tcp_watches = [c.watch("/fan/", heartbeat=5.0)
+                       for c in tcp_clients]
+
+        t0 = time.perf_counter()
+        for i in range(burst):
+            client.put(f"/fan/k{i}", str(i))
+        # drain: every stream sees the whole burst (+1 warm event for
+        # in-proc watches created after it)
+        need = burst
+
+        def _drain(watch) -> int:
+            got = 0
+            stop_at = time.monotonic() + 20.0
+            while got < need and time.monotonic() < stop_at:
+                batch = watch.get(timeout=0.5)
+                if batch is None:
+                    continue
+                got += sum(1 for ev in batch.events
+                           if ev.key != "/fan/warm")
+            return got
+
+        delivered = sum(_drain(w) for w in watches)
+        fanout_s = time.perf_counter() - t0
+        tcp_delivered = sum(_drain(w) for w in tcp_watches)
+        tcp_s = time.perf_counter() - t0
+
+        for w in watches:
+            w.cancel()
+        for w in tcp_watches:
+            w.cancel()
+        for c in tcp_clients:
+            c.close()
+        client.close()
+    total = streams * burst
+    tcp_total = tcp_streams * burst
+    return {
+        "store_watch_fanout_streams": streams + tcp_streams,
+        "store_watch_fanout_delivered_pct": round(
+            100.0 * (delivered + tcp_delivered) / max(total + tcp_total, 1),
+            2),
+        "store_watch_fanout_events_per_sec_core": round(
+            delivered / fanout_s, 1),
+        "store_watch_fanout_tcp_events_per_sec_core": round(
+            tcp_delivered / max(tcp_s, 1e-9), 1),
+    }
+
+
+def bench_failover(writers_hz: float = 100.0) -> dict:
+    """Kill the leader under write load: unavailability window =
+    last-ack-before-kill -> first-ack-after, with the zero-lost audit."""
+    from edl_tpu.coord.replication import ReplicaGroup
+
+    with ReplicaGroup(3, election_ttl=0.6) as group:
+        group.wait_leader(timeout=20.0)
+        client = group.client(timeout=3.0)
+        watcher = group.client(timeout=3.0)
+        watch = watcher.watch("/job/", start_revision=0)
+
+        acked: dict[str, int] = {}
+        stop = threading.Event()
+        gap = {"last_before": 0.0, "first_after": None}
+        killed_at = [None]
+
+        def writer() -> None:
+            i = 0
+            while not stop.is_set() and i < 2000:
+                try:
+                    rev = client.put(f"/job/rank/{i % 32}", f"p-{i}")
+                    now = time.perf_counter()
+                    acked[f"p-{i}"] = rev
+                    if killed_at[0] is None:
+                        gap["last_before"] = now
+                    elif gap["first_after"] is None:
+                        gap["first_after"] = now
+                except Exception:
+                    pass
+                i += 1
+                time.sleep(1.0 / writers_hz)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            time.sleep(0.5)
+            killed_at[0] = time.perf_counter()
+            group.kill_leader()
+            group.wait_leader(timeout=20.0)
+            deadline = time.monotonic() + 10.0
+            while gap["first_after"] is None \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.5)
+        finally:
+            stop.set()
+            t.join(timeout=15.0)
+
+        seen: set[int] = set()
+        deadline = time.monotonic() + 10.0
+        max_acked = max(acked.values(), default=0)
+        while time.monotonic() < deadline:
+            batch = watch.get(timeout=0.5)
+            if batch is None:
+                if seen and max(seen) >= max_acked:
+                    break
+                continue
+            seen.update(ev.revision for ev in batch.events)
+        lost = sum(1 for rev in acked.values() if rev not in seen)
+        watch.cancel()
+        watcher.close()
+        client.close()
+    downtime_ms = 0.0
+    if gap["first_after"] is not None:
+        downtime_ms = (gap["first_after"] - gap["last_before"]) * 1e3
+    return {
+        "store_failover_downtime_ms": round(downtime_ms, 1),
+        "store_failover_acked_writes": len(acked),
+        "store_events_lost": lost,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="replicated-store control-plane load bench")
+    parser.add_argument("--pods", type=int, default=2000,
+                        help="simulated pod registrations")
+    parser.add_argument("--streams", type=int, default=500,
+                        help="in-proc watch streams on one follower")
+    parser.add_argument("--tcp-streams", type=int, default=50,
+                        help="TCP watch streams on one follower")
+    parser.add_argument("--json", default=None,
+                        help="write the artifact JSON here")
+    args = parser.parse_args(argv)
+
+    out: dict = {"host_cores": os.cpu_count()}
+    out.update(bench_registrations(args.pods))
+    out.update(bench_watch_fanout(args.streams, args.tcp_streams))
+    out.update(bench_failover())
+
+    print(json.dumps(out, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+    if out["store_events_lost"] != 0:
+        print("FAIL: events lost across failover", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
