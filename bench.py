@@ -1545,6 +1545,44 @@ def bench_store_ha(on_tpu: bool) -> dict:
     }
 
 
+def bench_chaos(on_tpu: bool) -> dict:
+    """Deterministic chaos soak (ISSUE 12): the elastic world under a
+    seeded fault storm, judged by invariant audits.
+
+    Runs ``python -m edl_tpu.chaos soak`` (store replica group +
+    JobServer + worker pods + scaler + teacher pool) at a fixed seed
+    and reports the audited outcome:
+      - chaos_faults_survived / chaos_faults_injected: every injected
+        fault must resolve (recovered or typed error — never a hang);
+      - chaos_invariant_breaches: MUST be 0 (exactly-once watch
+        delivery, journal<->resize_log parity, bitwise restores, drain
+        discipline);
+      - chaos_max_downtime_s: worst observed kill -> re-registration
+        window across the storm;
+      - chaos_fault_classes: distinct injector classes exercised.
+    Host-side control plane: identical on every platform."""
+    del on_tpu
+    import subprocess
+    import sys as _sys
+    proc = subprocess.run(
+        [_sys.executable, "-m", "edl_tpu.chaos", "soak", "--seed", "1",
+         "--ticks", "12", "--settle-s", "10"],
+        capture_output=True, text=True, timeout=300)
+    summary = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("chaos_summary="):
+            summary = json.loads(line.split("=", 1)[1])
+            break
+    stats = summary.get("stats", {})
+    return {
+        "chaos_faults_injected": stats.get("faults_injected"),
+        "chaos_faults_survived": stats.get("faults_survived"),
+        "chaos_invariant_breaches": len(summary.get("breaches", [])),
+        "chaos_max_downtime_s": stats.get("max_downtime_s"),
+        "chaos_fault_classes": len(stats.get("fault_classes", [])),
+    }
+
+
 def distill_quality_extras() -> dict:
     """Surface the flagship distill QUALITY measurement (the reference's
     acc1 77.1->79.0 story) from the newest committed artifact —
@@ -1587,6 +1625,7 @@ def main() -> None:
     serving_slo = bench_serving_slo(on_tpu)
     control_plane = bench_control_plane(on_tpu)
     store_ha = bench_store_ha(on_tpu)
+    chaos = bench_chaos(on_tpu)
     cores_to_feed_jpeg = (resnet["imgs_per_sec"]
                           / max(loader["imgs_per_sec_per_core"], 1e-9))
     # the headline feed question, recomputed against the packed +
@@ -1732,6 +1771,11 @@ def main() -> None:
             # zero-lost-events audit + follower watch fan-out
             # (tools/store_bench.py has the load sweep)
             **store_ha,
+            # seeded chaos soak: faults injected/survived across the
+            # injector classes, invariant breaches (must be 0), worst
+            # observed recovery window (tools/chaos_bench.py sweeps
+            # seeds x fault mixes)
+            **chaos,
             # flagship distill QUALITY (committed artifact; see
             # tools/distill_quality_tpu.py)
             **distill_quality_extras(),

@@ -1,0 +1,37 @@
+"""Deterministic chaos plane: seeded fault injection at every seam.
+
+``python -m edl_tpu.chaos soak`` runs the full single-host elastic
+world under a seed-exact fault schedule and exits nonzero on any
+invariant breach — see doc/design_chaos.md for the injector catalog,
+the schedule/seed replay contract, and the invariant-to-artifact map.
+
+Lazy (PEP 562): importing the package costs nothing; the orchestrator
+itself never imports jax (asserted by the soak), so the chaos gate
+runs on a box with no accelerator stack.
+"""
+
+_LAZY = {
+    "ChaosSchedule": ("edl_tpu.chaos.schedule", "ChaosSchedule"),
+    "FaultEvent": ("edl_tpu.chaos.schedule", "FaultEvent"),
+    "FAULT_CLASSES": ("edl_tpu.chaos.schedule", "FAULT_CLASSES"),
+    "WireChaos": ("edl_tpu.chaos.faults", "WireChaos"),
+    "ProcessChaos": ("edl_tpu.chaos.faults", "ProcessChaos"),
+    "StorePartitioner": ("edl_tpu.chaos.faults", "StorePartitioner"),
+    "CheckpointCorruptor": ("edl_tpu.chaos.faults", "CheckpointCorruptor"),
+    "InvariantAuditor": ("edl_tpu.chaos.audit", "InvariantAuditor"),
+    "ChaosReport": ("edl_tpu.chaos.audit", "ChaosReport"),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module 'edl_tpu.chaos' has no attribute {name!r}") from None
+    import importlib
+    value = getattr(importlib.import_module(module_name), attr)
+    globals()[name] = value
+    return value

@@ -1,0 +1,85 @@
+"""CLI: ``python -m edl_tpu.chaos {soak,schedule,worker}``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="edl_tpu.chaos",
+        description="seeded fault injection + invariant audits")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    soak = sub.add_parser(
+        "soak", help="run the single-host elastic world under a seeded "
+                     "fault schedule; exit nonzero on invariant breach")
+    soak.add_argument("--seed", type=int, default=1)
+    soak.add_argument("--ticks", type=int, default=24,
+                      help="faults to inject (one per schedule tick)")
+    soak.add_argument("--tick-s", type=float, default=1.5)
+    soak.add_argument("--pods", type=int, default=2,
+                      help="initial trainer-world size")
+    soak.add_argument("--max-nodes", type=int, default=4)
+    soak.add_argument("--settle-s", type=float, default=12.0,
+                      help="post-storm convergence window")
+    soak.add_argument("--drain-deadline", type=float, default=5.0)
+    soak.add_argument("--artifacts", default=None,
+                      help="keep run artifacts (reports, journals, "
+                           "chaos_report.json) in this dir")
+    soak.add_argument("--weaken-checksums", action="store_true",
+                      help="disable chunk crc verification in workers: "
+                           "the injected corruption must then be caught "
+                           "by the AUDITOR (run exits nonzero)")
+    soak.add_argument("--mix", default=None,
+                      help="comma-joined fault-class subset (default: "
+                           "every class)")
+    soak.add_argument("--print-schedule", action="store_true",
+                      help="print the seeded schedule and exit")
+    soak.add_argument("--no-lockgraph", dest="lockgraph",
+                      action="store_false",
+                      help="skip the lock-order race detector")
+
+    sched = sub.add_parser(
+        "schedule", help="print a seed's fault schedule + fingerprint "
+                         "(the replay contract, stdlib-only)")
+    sched.add_argument("--seed", type=int, default=1)
+    sched.add_argument("--ticks", type=int, default=24)
+    sched.add_argument("--tick-s", type=float, default=1.5)
+    sched.add_argument("--pods", type=int, default=2)
+
+    worker = sub.add_parser(
+        "worker", help="one soak pod worker (spawned by the soak's "
+                       "supervisor; runnable standalone for debugging)")
+    from edl_tpu.chaos.worker import add_worker_args
+    add_worker_args(worker)
+
+    args = parser.parse_args(argv)
+    if args.cmd == "worker":
+        from edl_tpu.chaos.worker import run_worker
+        return run_worker(args)
+    if args.cmd == "schedule":
+        import json
+
+        from edl_tpu.chaos.schedule import ChaosSchedule
+        schedule = ChaosSchedule.generate(args.seed, args.ticks,
+                                          tick_s=args.tick_s,
+                                          pods=args.pods)
+        for e in schedule:
+            print(json.dumps(e.to_dict(), sort_keys=True))
+        print(f"fingerprint={schedule.fingerprint()}")
+        return 0
+    # soak: prove the orchestrator itself never pulled jax — the chaos
+    # gate must run on a box with no accelerator stack
+    from edl_tpu.chaos.soak import run_soak
+    rc = run_soak(args)
+    heavy = [m for m in ("jax", "flax", "optax") if m in sys.modules]
+    if heavy:
+        print(f"FAIL chaos orchestrator imported {heavy}")
+        return rc or 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

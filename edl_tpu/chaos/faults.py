@@ -1,0 +1,216 @@
+"""Fault injectors — at the seams, not monkeypatched internals.
+
+Four injector families, one per seam the system already exposes:
+
+- `WireChaos` installs into the fault hooks of ``coord/wire.py`` and
+  ``data/tensor_wire.py`` (every framed-JSON and tensor frame crosses
+  there): seeded drop (raise), delay (sleep), hard-close, and
+  garble-on-read. Faults surface to consumers exactly as real network
+  failures do — ConnectionError subtypes on the paths that already
+  handle them — so chaos exercises the SAME retry/reconnect/resync
+  code production faults would.
+- `ProcessChaos` signals real OS process groups through
+  ``collective/process.py`` handles: SIGKILL (crash), SIGSTOP/SIGCONT
+  (grey failure — alive to the OS, dead to every deadline).
+- `StorePartitioner` severs a `ReplicaNode` from a chosen subset of its
+  peers (``set_partition``) while its server socket keeps accepting
+  clients — including the asymmetric drill where a deposed leader is
+  still reachable by a client but cannot reach quorum.
+- `CheckpointCorruptor` truncates or bit-flips a sealed chunk file on
+  disk (below the npy header, so the corruption is silent to np.load
+  and only integrity checksums can catch it).
+
+Everything is driven by the soak's seeded schedule; the injectors
+themselves are mechanism, not policy.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+import struct
+import time
+
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.chaos.faults")
+
+
+class ChaosDropped(ConnectionError):
+    """A frame eaten by the wire injector (ConnectionError so every
+    existing transport-error path handles it as a real network fault)."""
+
+
+class WireChaos:
+    """Seeded per-frame fault policy for the wire seams.
+
+    One instance installs into BOTH wire modules; ``modes`` picks which
+    faults are live (drop/delay/close/garble) and ``rate`` the per-frame
+    probability. Draws come from the injector's own RNG — the schedule
+    (when and which mode) is seed-exact; which individual frame a fault
+    eats depends on thread interleaving, by design.
+    """
+
+    def __init__(self, seed: int, *, modes: tuple[str, ...] = ("drop",),
+                 rate: float = 0.2, delay_s: float = 0.05):
+        self._rng = random.Random(seed)
+        self.modes = modes
+        self.rate = rate
+        self.delay_s = delay_s
+        self._prev_wire = None
+        self._prev_tensor = None
+        self._installed = False
+        self.frames_faulted = 0
+
+    # -- hook protocol (coord/wire.py + data/tensor_wire.py) ---------------
+
+    def _hit(self) -> bool:
+        return self._rng.random() < self.rate
+
+    def on_send(self, sock: socket.socket, nbytes: int) -> None:
+        if "delay" in self.modes and self._hit():
+            self.frames_faulted += 1
+            time.sleep(self.delay_s)
+        if "close" in self.modes and self._hit():
+            self.frames_faulted += 1
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise ChaosDropped("chaos: connection hard-closed on send")
+        if "drop" in self.modes and self._hit():
+            self.frames_faulted += 1
+            raise ChaosDropped(f"chaos: dropped {nbytes}-byte frame")
+
+    def on_recv(self, sock: socket.socket, data: bytes, kind: str) -> bytes:
+        if "garble" in self.modes and data and self._hit():
+            self.frames_faulted += 1
+            i = self._rng.randrange(len(data))
+            return data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+        if "delay" in self.modes and self._hit():
+            self.frames_faulted += 1
+            time.sleep(self.delay_s)
+        return data
+
+    # -- install/uninstall (a scoped window in the soak) -------------------
+
+    def install(self) -> "WireChaos":
+        from edl_tpu.coord import wire
+        from edl_tpu.data import tensor_wire
+        if not self._installed:
+            self._prev_wire = wire.install_fault_hook(self)
+            self._prev_tensor = tensor_wire.install_fault_hook(self)
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        from edl_tpu.coord import wire
+        from edl_tpu.data import tensor_wire
+        if self._installed:
+            wire.install_fault_hook(self._prev_wire)
+            tensor_wire.install_fault_hook(self._prev_tensor)
+            self._installed = False
+
+    def __enter__(self) -> "WireChaos":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+class ProcessChaos:
+    """Process-plane faults over `collective/process.py` handles."""
+
+    @staticmethod
+    def sigkill(handle) -> bool:
+        from edl_tpu.collective.process import kill_trainer
+        return kill_trainer(handle)
+
+    @staticmethod
+    def sigstop(handle) -> bool:
+        from edl_tpu.collective.process import pause_trainer
+        return pause_trainer(handle)
+
+    @staticmethod
+    def sigcont(handle) -> bool:
+        from edl_tpu.collective.process import resume_trainer
+        return resume_trainer(handle)
+
+
+class StorePartitioner:
+    """Partition a replica node from (a subset of) its peers. Client
+    traffic to the node's own server socket keeps flowing — that is
+    the point: the quorum/fencing path is exercised from the CLIENT's
+    side, not by making the node vanish."""
+
+    @staticmethod
+    def sever(node, peers: bool | list[str] = True) -> None:
+        node.set_partition(peers)
+
+    @staticmethod
+    def heal(node) -> None:
+        node.set_partition(None)
+
+
+def _npy_data_offset(path: str) -> int:
+    """Byte offset where a .npy file's array data starts (v1/v2/v3
+    headers) — a corruption below this is invisible to np.load and
+    catchable only by integrity checksums."""
+    with open(path, "rb") as f:
+        magic = f.read(8)
+        if magic[:6] != b"\x93NUMPY":
+            return 0
+        major = magic[6]
+        if major >= 2:
+            (hlen,) = struct.unpack("<I", f.read(4))
+            return 12 + hlen
+        (hlen,) = struct.unpack("<H", f.read(2))
+        return 10 + hlen
+
+
+class CheckpointCorruptor:
+    """Corrupt a sealed checkpoint chunk on disk, deterministically per
+    RNG: pick the newest ``ckpt-N`` under a root, pick a chunk file,
+    then bit-flip one payload byte (``bitflip``) or cut the file short
+    (``truncate``). Returns a record of what was done — the soak's
+    auditor pairs it with the victim's detection report."""
+
+    @staticmethod
+    def corrupt(ckpt_root: str, rng: random.Random,
+                mode: str = "bitflip") -> dict | None:
+        try:
+            versions = sorted(
+                int(n.split("-", 1)[1]) for n in os.listdir(ckpt_root)
+                if n.startswith("ckpt-") and n.split("-", 1)[1].isdigit())
+        except OSError:
+            return None
+        if not versions:
+            return None
+        version = versions[-1]
+        vdir = os.path.join(ckpt_root, f"ckpt-{version}")
+        chunks = sorted(n for n in os.listdir(vdir) if n.endswith(".npy"))
+        if not chunks:
+            return None
+        fname = rng.choice(chunks)
+        path = os.path.join(vdir, fname)
+        size = os.path.getsize(path)
+        start = _npy_data_offset(path)
+        if mode == "truncate":
+            new_size = max(start, int(size * 0.6))
+            with open(path, "r+b") as f:
+                f.truncate(new_size)
+            detail = {"truncated_to": new_size}
+        else:
+            if size <= start:
+                return None  # empty payload: nothing silent to flip
+            offset = rng.randrange(start, size)
+            with open(path, "r+b") as f:
+                f.seek(offset)
+                byte = f.read(1)
+                f.seek(offset)
+                f.write(bytes([byte[0] ^ 0xFF]))
+            detail = {"offset": offset}
+        log.info("corrupted %s (%s %s)", path, mode, detail)
+        return {"root": ckpt_root, "version": version, "file": fname,
+                "mode": mode, **detail}

@@ -1,0 +1,144 @@
+"""Seeded, replayable fault schedules.
+
+A ``ChaosSchedule`` is a virtual-clock script of ``(t, fault, target,
+duration, params)`` events drawn ENTIRELY from a seed: two runs with the
+same (seed, ticks, mix) produce byte-identical schedules —
+``fingerprint()`` proves it — so any soak failure replays exactly by
+seed. Event TIMES are virtual seconds from soak start; the soak maps
+them onto the wall clock. What is deterministic is the injection
+script; the world's reaction (thread interleavings, which packet a wire
+fault eats) is not, which is why the soak asserts INVARIANTS, not
+states.
+
+Target strings are symbolic (``pod:1``, ``replica:leader``,
+``replica:follower``) and resolved live by the soak at injection time —
+"kill the leader" must mean the leader AT THAT MOMENT, not the one at
+schedule-generation time.
+
+Pure stdlib: schedules print, hash and diff on a box with nothing
+installed (``python -m edl_tpu.chaos schedule --seed 1``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from dataclasses import asdict, dataclass, field
+
+# The injector catalog (doc/design_chaos.md). The first five are the
+# acceptance classes; the last two drive the planes whose audit
+# artifacts (resize_log, drain_log, journal) the soak cross-checks.
+FAULT_CLASSES = (
+    "wire",             # seeded drop/delay/close/garble at the wire seams
+    "process-kill",     # SIGKILL a pod worker's process group
+    "process-pause",    # SIGSTOP for `duration`, then SIGCONT
+    "store-partition",  # sever a replica from its peers (client-reachable)
+    "leader-kill",      # crash the current store leader (no resign)
+    "ckpt-corrupt",     # bit-flip/truncate a sealed chunk on disk
+    "resize",           # JobServer fault-injected resize (trainer world)
+    "pool-resize",      # serving-pool resize through the actuator
+)
+
+# Per-class weights for the tail of the schedule (the head cycles every
+# class once, so the five-class acceptance floor never depends on luck).
+_WEIGHTS = {
+    "wire": 4, "process-kill": 3, "process-pause": 2,
+    "store-partition": 2, "leader-kill": 1, "ckpt-corrupt": 3,
+    "resize": 2, "pool-resize": 2,
+}
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    t: float                 # virtual seconds from soak start
+    fault: str               # one of FAULT_CLASSES
+    target: str              # symbolic: pod:N, replica:leader, pool, job
+    duration: float = 0.0    # transient faults: active window seconds
+    params: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def _draw_event(rng: random.Random, fault: str, t: float, *,
+                pods: int) -> FaultEvent:
+    if fault == "wire":
+        mode = rng.choice(["drop", "delay", "close", "garble"])
+        return FaultEvent(t, "wire", "wire:all", duration=round(
+            rng.uniform(0.4, 1.2), 3),
+            params={"mode": mode, "rate": round(rng.uniform(0.1, 0.4), 3),
+                    "delay_s": round(rng.uniform(0.02, 0.1), 3)})
+    if fault == "process-kill":
+        return FaultEvent(t, "process-kill", f"pod:{rng.randrange(pods)}")
+    if fault == "process-pause":
+        return FaultEvent(t, "process-pause", f"pod:{rng.randrange(pods)}",
+                          duration=round(rng.uniform(0.5, 1.5), 3))
+    if fault == "store-partition":
+        # half asymmetric (the leader keeps serving clients while cut
+        # off from quorum), half follower-side
+        target = rng.choice(["replica:leader", "replica:follower"])
+        return FaultEvent(t, "store-partition", target,
+                          duration=round(rng.uniform(1.0, 2.5), 3))
+    if fault == "leader-kill":
+        return FaultEvent(t, "leader-kill", "replica:leader")
+    if fault == "ckpt-corrupt":
+        return FaultEvent(t, "ckpt-corrupt", f"pod:{rng.randrange(pods)}",
+                          params={"mode": rng.choice(["bitflip",
+                                                      "truncate"])})
+    if fault == "resize":
+        return FaultEvent(t, "resize", "job")
+    if fault == "pool-resize":
+        return FaultEvent(t, "pool-resize", "pool",
+                          params={"delta": rng.choice([-1, 1, 1])})
+    raise ValueError(f"unknown fault class {fault!r}")
+
+
+class ChaosSchedule:
+    """An ordered list of `FaultEvent`s plus its generation recipe."""
+
+    def __init__(self, events: list[FaultEvent], *, seed: int,
+                 tick_s: float):
+        self.events = sorted(events, key=lambda e: (e.t, e.fault, e.target))
+        self.seed = seed
+        self.tick_s = tick_s
+
+    @classmethod
+    def generate(cls, seed: int, ticks: int, *, tick_s: float = 1.5,
+                 pods: int = 2, mix: list[str] | None = None
+                 ) -> "ChaosSchedule":
+        """One fault per tick. The head of the schedule cycles through
+        ``mix`` (default: every class) once in seeded order, the tail
+        draws weighted — so a run long enough for the acceptance floor
+        (>= len(mix) ticks) always spans every requested class."""
+        rng = random.Random(seed)
+        mix = list(mix) if mix else list(FAULT_CLASSES)
+        head = list(mix)
+        rng.shuffle(head)
+        weights = [_WEIGHTS.get(f, 1) for f in mix]
+        events = []
+        for i in range(ticks):
+            fault = head[i] if i < len(head) \
+                else rng.choices(mix, weights)[0]
+            t = round((i + 1) * tick_s + rng.uniform(0.0, tick_s / 3), 3)
+            events.append(_draw_event(rng, fault, t, pods=pods))
+        return cls(events, seed=seed, tick_s=tick_s)
+
+    def classes(self) -> set[str]:
+        return {e.fault for e in self.events}
+
+    def to_jsonable(self) -> list[dict]:
+        return [e.to_dict() for e in self.events]
+
+    def fingerprint(self) -> str:
+        """sha256 of the canonical JSON — the replay contract: same
+        (seed, ticks, tick_s, pods, mix) => same fingerprint, always."""
+        blob = json.dumps(self.to_jsonable(), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def __iter__(self):
+        return iter(self.events)

@@ -1,0 +1,838 @@
+"""The chaos soak: one host, the full elastic world, a seeded storm.
+
+``python -m edl_tpu.chaos soak --seed 1 --ticks 24`` builds the whole
+single-host elastic control plane —
+
+  - a 3-replica coordination store group (coord/replication.py), the
+    same quorum-lease/fencing stack production runs;
+  - a JobServer with a store-attached JobState (resize epochs publish);
+  - pod workers as REAL subprocesses (chaos/worker.py) supervised like
+    a launcher would: spawned to the desired world, respawned on death,
+    trimmed on shrink;
+  - a leader-elected ScalerController (ThroughputPolicy) observing the
+    workers' published utilization and actuating /resize;
+  - a serving pool (TeacherPoolActuator + stub teachers) draining on
+    every shrink;
+  - a mark probe: a writer streaming acked writes while a watch
+    consumes the event stream (the I1 exactly-once ledger)
+
+— then injects the seeded `ChaosSchedule` into it through the
+`faults` injectors, heals everything, lets the world settle, and runs
+the `InvariantAuditor` over the artifacts. Exit 0 iff zero invariant
+breaches. The schedule is seed-exact (``--print-schedule`` /
+``fingerprint``); the run's artifacts land in ``--artifacts`` (or a
+temp dir) for post-mortem replay of the audit.
+
+``--weaken-checksums`` runs the same storm with chunk crc verification
+disabled in the workers (EDL_TPU_CKPT_VERIFY=0): the injected
+corruption then sails through the runtime and the AUDITOR must catch
+it as an I3 bitwise-equality breach — the CI gate asserts this run
+exits nonzero, proving the audit has teeth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+from edl_tpu.chaos import faults as fl
+from edl_tpu.chaos.audit import InvariantAuditor, load_worker_reports
+from edl_tpu.chaos.schedule import ChaosSchedule
+from edl_tpu.chaos.worker import marks_prefix, world_key
+from edl_tpu.collective import register as reg
+from edl_tpu.collective.cluster import form_cluster
+from edl_tpu.collective.process import start_trainer, terminate_trainer
+from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.replication import ReplicaServer
+from edl_tpu.utils.exceptions import EdlError, EdlStoreError
+from edl_tpu.utils.logging import get_logger
+from edl_tpu.utils.net import free_port
+
+log = get_logger("edl_tpu.chaos.soak")
+
+JOB = "chaosjob"
+
+
+class StubTeacher:
+    """In-process TeacherHandle whose queue drains on a clock — enough
+    surface for the actuator's full drain protocol (deregister -> wait
+    for quiet stats -> graceful stop) without a serving stack."""
+
+    def __init__(self, index: int):
+        self.endpoint = f"stub:{index}"
+        self._born = time.monotonic()
+        self._gone = False
+
+    def stats(self) -> dict | None:
+        if self._gone:
+            return None
+        age = time.monotonic() - self._born
+        return {"queue_depth": max(0, 2 - int(age / 0.1)),
+                "inflight_groups": 0}
+
+    def deregister(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        self._gone = True
+
+    def kill(self) -> None:
+        self._gone = True
+
+
+class Supervisor:
+    """The launcher role, minimized: keep `desired` worker subprocesses
+    alive (respawn on death, trim on shrink), publish the cluster doc
+    when membership settles, and mirror `desired` into the store for
+    the workers' utilization records."""
+
+    def __init__(self, state, store: StoreClient, *, report_dir: str,
+                 ckpt_root: str, endpoints: str, max_nodes: int,
+                 worker_env: dict):
+        self.state = state
+        self.store = store
+        self.report_dir = report_dir
+        self.ckpt_root = ckpt_root
+        self.endpoints = endpoints
+        self.max_nodes = max_nodes
+        self.worker_env = worker_env
+        self.journal: list[dict] = []        # guarded-by: _lock
+        self._handles: dict[int, tuple[str, object]] = {}  # guarded-by: _lock
+        self._incarnation: dict[int, int] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._cluster_version = 0
+        self._last_pod_ids: set[str] = set()
+        self._last_world_pub = -1
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="chaos-supervisor")
+
+    def start(self) -> "Supervisor":
+        self._thread.start()
+        return self
+
+    def handle(self, slot: int):
+        with self._lock:
+            ent = self._handles.get(slot)
+            return ent[1] if ent else None
+
+    def live_slots(self) -> dict[int, bool]:
+        with self._lock:
+            return {slot: proc.alive()
+                    for slot, (_, proc) in self._handles.items()}
+
+    def _note(self, kind: str, **fields) -> None:
+        with self._lock:
+            self.journal.append({"kind": kind,
+                                 "ts": round(time.time(), 3), **fields})
+
+    def _spawn(self, slot: int) -> None:
+        with self._lock:
+            inc = self._incarnation.get(slot, 0)
+            self._incarnation[slot] = inc + 1
+        pod_id = f"pod{slot}-{inc}"
+        cmd = [sys.executable, "-m", "edl_tpu.chaos", "worker",
+               "--endpoints", self.endpoints, "--job", JOB,
+               "--pod-id", pod_id, "--slot", str(slot),
+               "--report", os.path.join(self.report_dir,
+                                        f"{pod_id}.jsonl"),
+               "--ckpt-dir", os.path.join(self.ckpt_root, f"pod{slot}"),
+               "--max-nodes", str(self.max_nodes)]
+        proc = start_trainer(cmd, self.worker_env,
+                             os.path.join(self.report_dir, "log"),
+                             rank=slot)
+        with self._lock:
+            self._handles[slot] = (pod_id, proc)
+        self._note("spawn", slot=slot, pod_id=pod_id, pid=proc.pid)
+
+    def _run(self) -> None:
+        while not self._stop.wait(0.25):
+            desired = self.state.snapshot()["desired_nodes"]
+            with self._lock:
+                slots = dict(self._handles)
+            for slot in range(desired):
+                ent = slots.get(slot)
+                if ent is None:
+                    self._spawn(slot)
+                elif not ent[1].alive():
+                    self._note("death_detected", slot=slot,
+                               pod_id=ent[0])
+                    self._spawn(slot)
+            for slot, (pod_id, proc) in slots.items():
+                if slot >= desired:
+                    terminate_trainer(proc, grace=2.0)
+                    with self._lock:
+                        if self._handles.get(slot, (None, None))[1] \
+                                is proc:
+                            del self._handles[slot]
+                    self._note("trim", slot=slot, pod_id=pod_id)
+            try:
+                self._publish(desired)
+            except (EdlError, OSError) as exc:
+                log.debug("supervisor publish failed: %s", exc)
+
+    def _publish(self, desired: int) -> None:
+        if desired != self._last_world_pub:
+            self.store.put(world_key(JOB), str(desired))
+            self._last_world_pub = desired
+        pods, _ = reg.live_pods(self.store, JOB)
+        ids = {p.pod_id for p in pods}
+        if ids and ids != self._last_pod_ids:
+            self._cluster_version += 1
+            cluster = form_cluster(JOB, self._cluster_version, pods)
+            self.store.put(reg.cluster_key(JOB), cluster.to_json())
+            self._last_pod_ids = ids
+            self._note("cluster_published",
+                       version=self._cluster_version, pods=sorted(ids))
+
+    def resume_all(self) -> None:
+        """SIGCONT every supervised worker (the settle phase's heal —
+        a pause window may still be pending when the storm ends)."""
+        from edl_tpu.collective.process import resume_trainer
+        with self._lock:
+            handles = list(self._handles.values())
+        for _pod_id, proc in handles:
+            resume_trainer(proc)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        with self._lock:
+            handles = list(self._handles.values())
+            self._handles.clear()
+        for _pod_id, proc in handles:
+            terminate_trainer(proc, grace=3.0)
+
+
+class MarkProbe:
+    """The I1 ledger: a writer streams acked marks, a dedicated watch
+    consumes the event stream; both sides' records feed the audit."""
+
+    def __init__(self, endpoints: str, *, rate_s: float = 0.06):
+        self.acked: dict[str, int] = {}   # writer-thread only until stop
+        self.refused = 0                  # writer-thread only until stop
+        self.seen: dict[int, str] = {}    # consumer-thread only until stop
+        self.duplicates = 0               # consumer-thread only until stop
+        self.branch_anomalies = 0         # consumer-thread only until stop
+        self.final_values: list[str] = []
+        self._rate_s = rate_s
+        self._client = StoreClient(endpoints, timeout=2.0,
+                                   connect_retries=6, retry_interval=0.1)
+        self._watch_client = StoreClient(endpoints, timeout=2.0,
+                                         connect_retries=6,
+                                         retry_interval=0.1)
+        self._watch = self._watch_client.watch(marks_prefix(JOB),
+                                               start_revision=0)
+        self._stop = threading.Event()
+        self._writer = threading.Thread(target=self._write_loop,
+                                        daemon=True, name="chaos-marks-w")
+        self._consumer = threading.Thread(target=self._consume_loop,
+                                          daemon=True,
+                                          name="chaos-marks-r")
+
+    def start(self) -> "MarkProbe":
+        self._writer.start()
+        self._consumer.start()
+        return self
+
+    def _write_loop(self) -> None:
+        i = 0
+        while not self._stop.wait(self._rate_s):
+            value = f"mark-{i}"
+            try:
+                rev = self._client.put(f"{marks_prefix(JOB)}{i:07d}",
+                                       value)
+                self.acked[value] = rev
+            except EdlStoreError:
+                # a refusal/timeout is NOT an ack: the mark may or may
+                # not exist; the audit only holds acked marks to the
+                # exactly-once bar
+                self.refused += 1
+            i += 1
+
+    def _consume_loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self._watch.get(timeout=0.2)
+            if batch is None:
+                continue
+            for ev in batch.events:
+                if ev.type != "PUT":
+                    continue
+                prev = self.seen.get(ev.revision)
+                if prev == ev.value:
+                    # the same (revision, value) twice = a true replay
+                    # duplicate (the resume contract broken)
+                    self.duplicates += 1
+                elif prev is not None:
+                    # same revision, DIFFERENT value: the watcher
+                    # observed a deposed leader's uncommitted suffix
+                    # whose revision numbers the new reign reused —
+                    # the documented weaker-than-Raft anomaly. Keep
+                    # the later (committed-branch) value.
+                    self.branch_anomalies += 1
+                self.seen[ev.revision] = ev.value
+
+    def probe_put(self) -> bool:
+        try:
+            self._client.put(f"/{JOB}/probe/live", str(time.time()))
+            return True
+        except EdlStoreError:
+            return False
+
+    def close(self) -> dict:
+        return self.stop_and_collect()
+
+    def stop_and_collect(self) -> dict:
+        if self._stop.is_set():  # idempotent: the crash path re-enters
+            return {"acked": self.acked, "seen": self.seen,
+                    "duplicates": self.duplicates,
+                    "branch_anomalies": self.branch_anomalies,
+                    "refused": self.refused,
+                    "final_values": self.final_values}
+        self._stop.set()
+        self._writer.join(timeout=10.0)
+        # drain whatever the watch still holds
+        deadline = time.monotonic() + 8.0
+        max_acked = max(self.acked.values(), default=0)
+        while time.monotonic() < deadline:
+            if self.seen and max(self.seen) >= max_acked:
+                break
+            time.sleep(0.1)
+        self._consumer.join(timeout=5.0)
+        self._watch.cancel()
+        try:
+            records, _ = self._client.get_prefix(marks_prefix(JOB))
+            self.final_values = [r.value for r in records]
+        except EdlStoreError:
+            pass
+        self._client.close()
+        self._watch_client.close()
+        return {"acked": self.acked, "seen": self.seen,
+                "duplicates": self.duplicates,
+                "branch_anomalies": self.branch_anomalies,
+                "refused": self.refused,
+                "final_values": self.final_values}
+
+
+class SoakWorld:
+    """Build, storm, settle, audit — one soak run."""
+
+    def __init__(self, args):
+        self.args = args
+        self.rng = random.Random(args.seed * 7919 + 17)
+        self.artifacts = args.artifacts or tempfile.mkdtemp(
+            prefix="edl-chaos-")
+        self._own_artifacts = args.artifacts is None
+        self.injections: list[dict] = []
+        self.pool_journal: list[dict] = []
+        self._pending: list[tuple[float, str, object]] = []
+        self._wire_active: fl.WireChaos | None = None
+        self.max_downtime_s = 0.0
+
+    # -- construction -------------------------------------------------------
+
+    def build(self) -> None:
+        from edl_tpu.collective.job_server import JobServer, JobState
+        from edl_tpu.scaler.controller import (ScalerConfig,
+                                               ScalerController)
+        from edl_tpu.scaler.policy import ThroughputPolicy
+        from edl_tpu.scaler.serving import TeacherPoolActuator
+
+        ports = [free_port() for _ in range(3)]
+        self.endpoints = [f"127.0.0.1:{p}" for p in ports]
+        self.endpoints_spec = ",".join(self.endpoints)
+        self.replicas: list[ReplicaServer | None] = [
+            ReplicaServer(self.endpoints[i], ports[i], host="127.0.0.1",
+                          group_endpoints=self.endpoints,
+                          election_ttl=0.6, commit_timeout=1.5).start()
+            for i in range(3)]
+        self._wait_leader(20.0)
+
+        self.store = StoreClient(self.endpoints_spec, timeout=2.0,
+                                 connect_retries=8, retry_interval=0.1)
+        self.state = JobState(JOB, 1, self.args.max_nodes,
+                              desired=self.args.pods,
+                              seed=self.args.seed, store=self.store)
+        self.job_server = JobServer(self.state, port=0).start()
+
+        worker_env = dict(os.environ)
+        worker_env.setdefault("EDL_TPU_WIRE_STALL_S", "10")
+        if self.args.weaken_checksums:
+            worker_env["EDL_TPU_CKPT_VERIFY"] = "0"
+        self.report_dir = os.path.join(self.artifacts, "reports")
+        self.ckpt_root = os.path.join(self.artifacts, "ckpt")
+        os.makedirs(self.report_dir, exist_ok=True)
+        self.supervisor = Supervisor(
+            self.state, self.store, report_dir=self.report_dir,
+            ckpt_root=self.ckpt_root, endpoints=self.endpoints_spec,
+            max_nodes=self.args.max_nodes, worker_env=worker_env).start()
+
+        self.journal_path = os.path.join(self.artifacts, "scaler.jsonl")
+        self.scaler_store = StoreClient(self.endpoints_spec, timeout=2.0,
+                                        connect_retries=8,
+                                        retry_interval=0.1)
+        self.scaler = ScalerController(
+            self.scaler_store, [JOB],
+            ThroughputPolicy(cooldown_s=4.0, horizon_s=30.0),
+            config=ScalerConfig(interval=1.0, cooldown_s=4.0,
+                                staleness_s=4.0, downtime_s=0.3),
+            job_server=f"127.0.0.1:{self.job_server.port}",
+            journal_path=self.journal_path, owner="chaos-soak").start()
+
+        self.actuator = TeacherPoolActuator(
+            lambda i: StubTeacher(i), min_teachers=1,
+            max_teachers=4, drain_deadline_s=self.args.drain_deadline,
+            service="chaos-teachers")
+        self.pool_journal.append({"to": 1, "ts": round(time.time(), 3)})
+        self.actuator.resize(1)
+
+        self.probe = MarkProbe(self.endpoints_spec).start()
+
+    def _wait_leader(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if any(s is not None and s.node.is_leader()
+                   for s in self.replicas):
+                return
+            time.sleep(0.05)
+        raise EdlStoreError("no store leader within "
+                            f"{timeout}s of {self.endpoints}")
+
+    # -- injection ----------------------------------------------------------
+
+    def _leader_index(self) -> int | None:
+        for i, srv in enumerate(self.replicas):
+            if srv is not None and srv.node.is_leader():
+                return i
+        return None
+
+    def _resolve_replica(self, target: str) -> int | None:
+        leader = self._leader_index()
+        if target == "replica:leader":
+            return leader
+        for i, srv in enumerate(self.replicas):
+            if srv is not None and i != leader:
+                return i
+        return None
+
+    def inject(self, event) -> None:
+        rec = {"t": event.t, "fault": event.fault, "target": event.target,
+               "duration": event.duration, "params": dict(event.params),
+               "wall": round(time.time(), 3), "resolution": None}
+        self.injections.append(rec)
+        fault = event.fault
+        try:
+            if fault == "wire":
+                if self._wire_active is not None:
+                    rec["resolution"] = {"skipped": "wire window overlap"}
+                    return
+                chaos = fl.WireChaos(
+                    self.rng.randrange(1 << 30),
+                    modes=(event.params["mode"],),
+                    rate=event.params["rate"],
+                    delay_s=event.params.get("delay_s", 0.05)).install()
+                self._wire_active = chaos
+                rec["hook"] = id(chaos)
+                self._pending.append(
+                    (time.monotonic() + event.duration, "wire-heal",
+                     chaos))
+            elif fault in ("process-kill", "process-pause"):
+                slot = int(event.target.split(":", 1)[1])
+                handle = self.supervisor.handle(slot)
+                if handle is None:
+                    rec["resolution"] = {"skipped": f"no pod at {slot}"}
+                    return
+                if fault == "process-kill":
+                    fl.ProcessChaos.sigkill(handle)
+                else:
+                    fl.ProcessChaos.sigstop(handle)
+                    self._pending.append(
+                        (time.monotonic() + event.duration,
+                         "sigcont", handle))
+                rec["slot"] = slot
+            elif fault == "store-partition":
+                idx = self._resolve_replica(event.target)
+                if idx is None:
+                    rec["resolution"] = {"skipped": "no such replica"}
+                    return
+                srv = self.replicas[idx]
+                fl.StorePartitioner.sever(srv.node, True)
+                rec["replica"] = self.endpoints[idx]
+                rec["was_leader"] = event.target == "replica:leader"
+                self._pending.append(
+                    (time.monotonic() + event.duration,
+                     "partition-heal", srv.node))
+            elif fault == "leader-kill":
+                idx = self._leader_index()
+                if idx is None:
+                    rec["resolution"] = {"skipped": "no leader right now"}
+                    return
+                srv = self.replicas[idx]
+                srv.kill()
+                self.replicas[idx] = None
+                rec["replica"] = self.endpoints[idx]
+                self._pending.append(
+                    (time.monotonic() + 1.5, "replica-respawn", idx))
+            elif fault == "ckpt-corrupt":
+                slot = int(event.target.split(":", 1)[1])
+                mode = ("bitflip" if self.args.weaken_checksums
+                        else event.params.get("mode", "bitflip"))
+                done = None
+                for probe_slot in ([slot] + list(range(self.args.pods))):
+                    done = fl.CheckpointCorruptor.corrupt(
+                        os.path.join(self.ckpt_root, f"pod{probe_slot}"),
+                        self.rng, mode)
+                    if done is not None:
+                        break
+                if done is None:
+                    rec["resolution"] = {"skipped": "no sealed ckpt yet"}
+                else:
+                    rec["corrupted"] = done
+            elif fault == "resize":
+                snap = self.state.random_resize()
+                rec["desired"] = snap["desired_nodes"]
+            elif fault == "pool-resize":
+                delta = int(event.params.get("delta", 1))
+                cur = self.pool_journal[-1]["to"]
+                if cur <= 1:
+                    delta = abs(delta)   # a clamped no-op exercises
+                elif cur >= 4:           # nothing: bounce off the rails
+                    delta = -abs(delta)  # so grows AND drains happen
+                desired = max(1, min(4, cur + delta))
+                self.pool_journal.append({"to": desired,
+                                          "ts": round(time.time(), 3)})
+                self.actuator.resize(desired)
+                rec["desired"] = desired
+        except Exception as exc:  # noqa: BLE001 — an injector crashing
+            # is a soak bug, not a system breach; surface it loudly
+            rec["resolution"] = {"typed_error": f"injector: {exc}"}
+            log.exception("injector for %s failed", fault)
+
+    def run_pending(self) -> None:
+        now = time.monotonic()
+        due = [p for p in self._pending if p[0] <= now]
+        self._pending = [p for p in self._pending if p[0] > now]
+        for _, kind, payload in due:
+            try:
+                if kind == "wire-heal":
+                    payload.uninstall()
+                    if self._wire_active is payload:
+                        self._wire_active = None
+                elif kind == "sigcont":
+                    fl.ProcessChaos.sigcont(payload)
+                elif kind == "partition-heal":
+                    fl.StorePartitioner.heal(payload)
+                elif kind == "replica-respawn":
+                    self._respawn_replica(payload)
+            except Exception:  # noqa: BLE001 — retried at settle
+                log.exception("pending action %s failed", kind)
+
+    def _respawn_replica(self, idx: int) -> None:
+        if self.replicas[idx] is not None:
+            return
+        port = int(self.endpoints[idx].rsplit(":", 1)[1])
+        try:
+            self.replicas[idx] = ReplicaServer(
+                self.endpoints[idx], port, host="127.0.0.1",
+                group_endpoints=self.endpoints,
+                election_ttl=0.6, commit_timeout=1.5).start()
+            log.info("respawned replica %s", self.endpoints[idx])
+        except OSError as exc:
+            log.warning("replica respawn %s failed (%s); retrying",
+                        self.endpoints[idx], exc)
+            self._pending.append(
+                (time.monotonic() + 1.0, "replica-respawn", idx))
+
+    # -- the run ------------------------------------------------------------
+
+    def storm(self, schedule: ChaosSchedule) -> None:
+        t0 = time.monotonic()
+        for event in schedule:
+            while time.monotonic() - t0 < event.t:
+                self.run_pending()
+                time.sleep(0.03)
+            log.info("inject t=%.2f %s @ %s", event.t, event.fault,
+                     event.target)
+            self.inject(event)
+        # drain remaining heals
+        while self._pending:
+            self.run_pending()
+            time.sleep(0.05)
+
+    def settle(self) -> None:
+        """Heal everything, then give the world a bounded window to
+        converge before the audit freezes the artifacts."""
+        if self._wire_active is not None:
+            self._wire_active.uninstall()
+            self._wire_active = None
+        for srv in self.replicas:
+            if srv is not None:
+                fl.StorePartitioner.heal(srv.node)
+        for i, srv in enumerate(self.replicas):
+            if srv is None:
+                self._respawn_replica(i)
+        self.supervisor.resume_all()
+        self._wait_leader(20.0)
+        deadline = time.monotonic() + self.args.settle_s
+        while time.monotonic() < deadline:
+            desired = self.state.snapshot()["desired_nodes"]
+            live = self.supervisor.live_slots()
+            if len(live) == desired and all(live.values()) \
+                    and self.probe.probe_put():
+                break
+            time.sleep(0.2)
+        # one more worker verify pass over the final checkpoint state
+        time.sleep(1.5)
+
+    def resolve(self) -> None:
+        """Fill every injection's resolution from the artifacts.
+
+        Bounded retry: recovery is asynchronous (a respawned worker is
+        still claiming its rank, a fresh incarnation still mid-verify
+        over a corrupted dir), so an unrecovered verdict is re-derived
+        from fresh artifacts for up to ~12 s before it stands. A fault
+        that STAYS unrecovered past the window is the breach."""
+        deadline = time.monotonic() + 12.0
+        while True:
+            self._resolve_pass()
+            failed = [i for i in self.injections
+                      if i["resolution"] is not None
+                      and i["resolution"].get("recovered") is False]
+            if not failed or time.monotonic() >= deadline:
+                return
+            for inj in failed:
+                inj["resolution"] = None
+            time.sleep(1.0)
+
+    def _resolve_pass(self) -> None:
+        reports = self._reports_by_slot()
+        probe_ok = self.probe.probe_put()
+        leader_ok = self._leader_index() is not None
+        desired = self.state.snapshot()["desired_nodes"]
+        live = self.supervisor.live_slots()
+        for inj in self.injections:
+            if inj["resolution"] is not None:
+                continue
+            fault = inj["fault"]
+            if fault == "wire":
+                inj["resolution"] = (
+                    {"recovered": True, "probe_put": True} if probe_ok
+                    else {"recovered": False,
+                          "detail": "store unreachable after heal"})
+            elif fault == "process-kill":
+                inj["resolution"] = self._resolve_respawn(inj, reports)
+            elif fault == "process-pause":
+                slot = inj.get("slot")
+                after = [r for r in reports.get(f"pod{slot}", ())
+                         if r.get("ts", 0) > inj["wall"]
+                         + inj["duration"]]
+                inj["resolution"] = (
+                    {"recovered": True} if after else
+                    {"recovered": False,
+                     "detail": f"pod{slot} silent after SIGCONT"})
+            elif fault in ("store-partition", "leader-kill"):
+                inj["resolution"] = (
+                    {"recovered": True} if (leader_ok and probe_ok) else
+                    {"recovered": False,
+                     "detail": f"leader={leader_ok} probe={probe_ok}"})
+            elif fault == "ckpt-corrupt":
+                inj["resolution"] = self._resolve_corrupt(inj, reports)
+            elif fault == "resize":
+                ok = len(live) == desired and all(live.values())
+                inj["resolution"] = (
+                    {"recovered": True} if ok else
+                    {"recovered": False,
+                     "detail": f"live={live} desired={desired}"})
+            elif fault == "pool-resize":
+                want = self.pool_journal[-1]["to"]
+                got = self.actuator.pool_size()
+                inj["resolution"] = (
+                    {"recovered": True} if got == want else
+                    {"recovered": False,
+                     "detail": f"pool={got} wanted={want}"})
+            else:
+                inj["resolution"] = {"skipped": f"unknown fault {fault}"}
+
+    def _resolve_respawn(self, inj: dict, reports: dict) -> dict:
+        slot = inj.get("slot")
+        regs = [r for r in reports.get(f"pod{slot}", ())
+                if r.get("kind") == "registered"
+                and r.get("ts", 0) > inj["wall"]]
+        if not regs:
+            # a slot the world shrank below is RETIRED, not owed a
+            # respawn — the kill resolved into the smaller world
+            if slot >= self.state.snapshot()["desired_nodes"]:
+                return {"recovered": True,
+                        "detail": f"slot {slot} retired by shrink"}
+            return {"recovered": False,
+                    "detail": f"no re-registration on slot {slot}"}
+        downtime = regs[0]["ts"] - inj["wall"]
+        self.max_downtime_s = max(self.max_downtime_s, downtime)
+        return {"recovered": True, "downtime_s": round(downtime, 3)}
+
+    def _resolve_corrupt(self, inj: dict, reports: dict) -> dict:
+        if self.args.weaken_checksums:
+            # detection is OFF by design: the breach must come from the
+            # auditor's bitwise check, not from runtime verification
+            return {"skipped": "checksums weakened — audit must catch"}
+        done = inj.get("corrupted") or {}
+        slot_dir = os.path.basename(done.get("root", ""))
+        hits = [r for r in reports.get(slot_dir, ())
+                if r.get("kind") == "ckpt_corrupt_detected"
+                and int(r.get("version", -1)) == int(done.get("version",
+                                                             -2))]
+        if hits:
+            return {"recovered": True, "typed_error": hits[0]["error"]}
+        return {"recovered": False,
+                "detail": f"corruption of {done} never detected"}
+
+    def _reports_by_slot(self) -> dict[str, list[dict]]:
+        """Worker reports merged per SLOT (incarnations share a slot's
+        checkpoint dir, so seal/restore pairing must merge them)."""
+        merged: dict[str, list[dict]] = {}
+        for pod_id, records in load_worker_reports(
+                self.report_dir).items():
+            slot = pod_id.split("-", 1)[0]
+            merged.setdefault(slot, []).extend(records)
+        for records in merged.values():
+            records.sort(key=lambda r: r.get("ts", 0.0))
+        return merged
+
+    # -- teardown + audit ---------------------------------------------------
+
+    def shutdown(self) -> dict:
+        """Idempotent teardown (the crash path calls it too)."""
+        if getattr(self, "_closed", False):
+            return getattr(self, "_probe_doc", {})
+        self._closed = True
+        probe_doc = {}
+        if hasattr(self, "probe"):
+            probe_doc = self.probe.stop_and_collect()
+        for name in ("scaler", "supervisor"):
+            if hasattr(self, name):
+                getattr(self, name).stop()
+        if hasattr(self, "actuator"):
+            self.actuator.wait_drains(
+                timeout=self.args.drain_deadline + 5)
+            self.actuator.close()
+        if hasattr(self, "job_server"):
+            self.job_server.stop()
+        for srv in getattr(self, "replicas", []):
+            if srv is not None:
+                srv.stop()
+        for name in ("store", "scaler_store"):
+            if hasattr(self, name):
+                getattr(self, name).close()
+        self._probe_doc = probe_doc
+        return probe_doc
+
+    def cleanup(self) -> None:
+        if self._own_artifacts:
+            shutil.rmtree(self.artifacts, ignore_errors=True)
+
+
+def run_soak(args) -> int:
+    mix = None
+    if getattr(args, "mix", None):
+        mix = [m.strip() for m in args.mix.split(",") if m.strip()]
+    schedule = ChaosSchedule.generate(args.seed, args.ticks,
+                                      tick_s=args.tick_s, pods=args.pods,
+                                      mix=mix)
+    print(f"chaos schedule: seed={args.seed} ticks={args.ticks} "
+          f"events={len(schedule)} classes={sorted(schedule.classes())} "
+          f"fingerprint={schedule.fingerprint()}", flush=True)
+    if args.print_schedule:
+        for e in schedule:
+            print(json.dumps(e.to_dict(), sort_keys=True))
+        return 0
+
+    os.environ.setdefault("EDL_TPU_WIRE_STALL_S", "10")
+    lock_report = None
+    if args.lockgraph:
+        from edl_tpu.analysis import lockgraph
+        graph = lockgraph.install()
+
+    # Global deadline: a soak that WEDGES is itself an invariant breach
+    # (the "never a hang" clause) — die loudly with a diagnosis instead
+    # of hanging CI.
+    budget = args.ticks * args.tick_s + args.settle_s + 90.0
+    hang = threading.Timer(budget, _die_hanging, args=(budget,))
+    hang.daemon = True
+    hang.start()
+
+    world = SoakWorld(args)
+    try:
+        world.build()
+        world.storm(schedule)
+        world.settle()
+        world.resolve()
+        probe_doc = world.shutdown()
+        if args.lockgraph:
+            lock_report = graph.report()
+
+        auditor = InvariantAuditor(
+            injections=world.injections,
+            worker_reports=world._reports_by_slot(),
+            probe=probe_doc,
+            scaler_journal=_load_journal(world.journal_path),
+            job_resize_log=list(world.state.resize_log),
+            pool_journal=world.pool_journal,
+            pool_resize_log=list(world.actuator.resize_log),
+            drain_log=list(world.actuator.drain_log),
+            drain_deadline_s=args.drain_deadline)
+        report = auditor.audit()
+        if lock_report is not None and not lock_report["ok"]:
+            report.breach(f"lockgraph: {len(lock_report['cycles'])} "
+                          f"cycles, {len(lock_report['hazards'])} "
+                          "hazards")
+        report.stats["fault_classes"] = sorted(
+            {i["fault"] for i in world.injections})
+        report.stats["max_downtime_s"] = round(world.max_downtime_s, 3)
+        report.stats["schedule_fingerprint"] = schedule.fingerprint()
+        report.stats["seed"] = args.seed
+        with open(os.path.join(world.artifacts, "chaos_report.json"),
+                  "w") as f:
+            json.dump({"report": report.to_dict(),
+                       "injections": world.injections}, f, indent=1)
+        print("chaos_summary=" + json.dumps(report.to_dict(),
+                                            sort_keys=True), flush=True)
+        for b in report.breaches:
+            log.error("INVARIANT BREACH: %s", b)
+        if report.ok:
+            print(f"chaos soak: {report.stats['faults_injected']} faults "
+                  f"across {len(report.stats['fault_classes'])} classes, "
+                  "zero invariant breaches")
+        else:
+            print(f"chaos soak: {len(report.breaches)} invariant "
+                  "breach(es)")
+        return 0 if report.ok else 1
+    finally:
+        hang.cancel()
+        try:
+            world.shutdown()
+        except Exception:  # noqa: BLE001 — teardown on the crash path
+            log.exception("soak teardown failed")
+        if args.lockgraph:
+            from edl_tpu.analysis import lockgraph
+            lockgraph.uninstall()
+        world.cleanup()
+
+
+def _load_journal(path: str) -> list[dict]:
+    from edl_tpu.chaos.audit import load_jsonl
+    return load_jsonl(path)
+
+
+def _die_hanging(budget: float) -> None:
+    import faulthandler
+    print(f"chaos soak exceeded its {budget:.0f}s global deadline — "
+          "dumping stacks and aborting (a hang IS a breach)",
+          flush=True)
+    faulthandler.dump_traceback()
+    os._exit(3)

@@ -1,0 +1,332 @@
+"""Chaos soak pod worker — a real subprocess exercising the real seams.
+
+One worker is the soak's stand-in for a launcher pod, built from the
+SAME primitives production pods use (no chaos-only protocol): it claims
+a rank slot with a leased `PodRegister`, consumes the mark stream over
+a resumable watch, publishes utilization records the autoscaler
+digests, and runs a checkpoint plane — sealing sharded-format versions
+(``train/ckpt_io``, numpy-only: a worker never imports jax) and
+restore-verifying EVERY retained version each pass, crc-checked, with
+fallback-to-previous on corruption.
+
+Everything the worker observes goes to an append-only JSONL report
+(one line per event, flushed immediately so a SIGKILL loses at most
+the in-flight line): registration, lease losses and re-claims, watch
+batches (revisions + compaction markers), seal digests, restore
+digests, detected corruption, and every typed error survived. The
+report is the worker's half of the invariant audit: the soak's
+`InvariantAuditor` cross-checks it against what was injected.
+
+Faults this process is expected to survive or die loudly under:
+SIGKILL (the supervisor respawns a new incarnation on the same slot —
+same checkpoint dir, so it restores the previous incarnation's state),
+SIGSTOP/SIGCONT (leases may expire; the worker re-claims and reports),
+store partitions and wire faults (typed store errors, backoff, retry),
+and on-disk checkpoint corruption (typed detection + fallback).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+import numpy as np
+
+from edl_tpu.collective import register as reg
+from edl_tpu.collective.cluster import Cluster, Pod
+from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.collector import util_key
+from edl_tpu.train import ckpt_io
+from edl_tpu.utils.backoff import Backoff
+from edl_tpu.utils.exceptions import EdlCheckpointCorrupt, EdlError
+from edl_tpu.utils.logging import get_logger
+
+log = get_logger("edl_tpu.chaos.worker")
+
+
+def marks_prefix(job_id: str) -> str:
+    return f"/{job_id}/marks/"
+
+
+def world_key(job_id: str) -> str:
+    return f"/{job_id}/world"
+
+
+class Reporter:
+    """Append-only JSONL event log, flushed per line."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def __call__(self, kind: str, **fields) -> None:
+        rec = {"kind": kind, "ts": round(time.time(), 3), **fields}
+        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def _payload(slot: int, version: int) -> dict[str, np.ndarray]:
+    """Deterministic per-(slot, version) state: the seal/restore digest
+    pair is checkable without shipping the arrays anywhere."""
+    rng = np.random.default_rng(slot * 100_003 + version)
+    return {"w": rng.standard_normal((64, 8)).astype(np.float32),
+            "b": np.arange(version + 8, dtype=np.int64)}
+
+
+def _digest(arrays: dict[str, np.ndarray]) -> str:
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        arr = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+        h.update(memoryview(arr).cast("B"))
+    return h.hexdigest()
+
+
+class CheckpointRig:
+    """Seal + verify loop over the sharded chunk format (ckpt_io).
+
+    Seal: write chunks + crc'd index into a tmp dir, atomic-rename to
+    ``ckpt-N`` (the manager's torn-save discipline), keep the newest 3.
+    Verify: for EVERY retained version, load each chunk through a
+    crc-checking `ChunkFiles`, assemble the full arrays, digest — a
+    corrupt version is reported, quarantined (renamed ``corrupt-N``)
+    and the previous sealed version is what the worker falls back to.
+    """
+
+    KEEP = 3
+
+    def __init__(self, directory: str, slot: int, report: Reporter):
+        self.directory = directory
+        self.slot = slot
+        self.report = report
+        os.makedirs(directory, exist_ok=True)
+        for name in os.listdir(directory):  # torn saves from a SIGKILL
+            if name.startswith(".tmp-"):
+                shutil.rmtree(os.path.join(directory, name),
+                              ignore_errors=True)
+        self.version = 1 + max(self.versions(), default=-1)
+
+    def versions(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("ckpt-") and name[5:].isdigit():
+                out.append(int(name[5:]))
+        return sorted(out)
+
+    def seal(self) -> None:
+        version = self.version
+        arrays = _payload(self.slot, version)
+        leaves, chunks = [], []
+        for i, name in enumerate(sorted(arrays)):
+            arr = arrays[name]
+            fname = ckpt_io.chunk_name(i, tuple(0 for _ in arr.shape))
+            chunks.append((fname, arr))
+            leaves.append({"key": name, "shape": list(arr.shape),
+                           "dtype": str(arr.dtype),
+                           "chunks": [{"offset": [0] * arr.ndim,
+                                       "shape": list(arr.shape),
+                                       "file": fname}]})
+        tmp = os.path.join(self.directory, f".tmp-{version}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        ckpt_io.write_snapshot(tmp, {"leaves": leaves, "chunks": chunks,
+                                     "process_index": 0})
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"version": version}, f)
+        os.rename(tmp, os.path.join(self.directory, f"ckpt-{version}"))
+        self.report("seal", version=version, digest=_digest(arrays))
+        self.version += 1
+        for old in self.versions()[:-self.KEEP]:
+            shutil.rmtree(os.path.join(self.directory, f"ckpt-{old}"),
+                          ignore_errors=True)
+
+    def _read_version(self, version: int) -> dict[str, np.ndarray]:
+        vdir = os.path.join(self.directory, f"ckpt-{version}")
+        merged = ckpt_io.read_merged_index(vdir)
+        files = ckpt_io.ChunkFiles(vdir, crcs=ckpt_io.checksum_map(merged))
+        try:
+            out = {}
+            for key, entry in merged.items():
+                region = tuple(slice(0, s) for s in entry["shape"])
+                out[key] = np.array(
+                    ckpt_io.read_region(files.load, entry, region))
+            return out
+        finally:
+            files.close()
+
+    def verify_all(self) -> None:
+        for version in self.versions():
+            try:
+                arrays = self._read_version(version)
+            except EdlCheckpointCorrupt as exc:
+                # typed detection -> quarantine -> the newest GOOD
+                # version is the fallback (reported so the auditor can
+                # pair detection with the injected corruption)
+                self.report("ckpt_corrupt_detected", version=version,
+                            error=str(exc))
+                vdir = os.path.join(self.directory, f"ckpt-{version}")
+                os.rename(vdir, os.path.join(self.directory,
+                                             f"corrupt-{version}"))
+                good = [v for v in self.versions() if v != version]
+                self.report("ckpt_fallback", bad=version,
+                            to=max(good) if good else None)
+                continue
+            self.report("restore", version=version,
+                        digest=_digest(arrays),
+                        newest=version == self.versions()[-1])
+
+
+def run_worker(args) -> int:
+    report = Reporter(args.report)
+    stop = {"flag": False}
+
+    def _term(signum, frame):  # noqa: ARG001 — signal signature
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _term)
+    report("started", pod_id=args.pod_id, slot=args.slot, pid=os.getpid(),
+           verify=ckpt_io.verify_enabled())
+
+    store = StoreClient(args.endpoints, timeout=2.0, connect_retries=8,
+                        retry_interval=0.1)
+    rig = CheckpointRig(args.ckpt_dir, args.slot, report)
+    rig.seal()  # a sealed version exists from the first instant: the
+    # corruptor never races an empty directory
+
+    pod = Pod(pod_id=args.pod_id, addr="127.0.0.1", n_devices=1)
+    register = reg.PodRegister(store, args.job, pod,
+                               max_nodes=args.max_nodes, ttl=args.ttl)
+    backoff = Backoff(base=0.1, max_delay=1.0)
+    rank = None
+    watch = None
+    watch_from = 0  # resume anchor across watch re-creation
+    watch_client = StoreClient(args.endpoints, timeout=2.0,
+                               connect_retries=8, retry_interval=0.1)
+    last_seal = time.monotonic()
+    last_verify = time.monotonic()
+    try:
+        while not stop["flag"]:
+            # -- membership: claim once, re-claim whenever the lease dies
+            if rank is None or register.lost.is_set():
+                if register.lost.is_set():
+                    report("lease_lost", rank=rank)
+                    register.release()
+                    register = reg.PodRegister(store, args.job, pod,
+                                               max_nodes=args.max_nodes,
+                                               ttl=args.ttl)
+                try:
+                    rank = register.claim(timeout=10.0)
+                    report("registered", rank=rank)
+                    backoff.reset()
+                except (EdlError, OSError) as exc:
+                    report("typed_error", where="claim", error=str(exc))
+                    if _sleep(backoff, stop):
+                        break
+                    continue
+            # -- the mark stream: resumable watch, resync on compaction
+            if watch is None:
+                try:
+                    watch = watch_client.watch(marks_prefix(args.job),
+                                               start_revision=watch_from)
+                    report("watch_created", start_revision=watch_from)
+                except EdlError as exc:
+                    report("typed_error", where="watch", error=str(exc))
+            if watch is not None:
+                batch = watch.get(timeout=0.05)
+                while batch is not None:
+                    if batch.compacted:
+                        marks, rev = store.get_prefix(
+                            marks_prefix(args.job))
+                        report("watch_compacted", revision=batch.revision,
+                               resync_marks=len(marks), resync_rev=rev)
+                        watch_from = max(watch_from, rev)
+                    else:
+                        report("watch", revisions=[e.revision
+                                                   for e in batch.events])
+                        if batch.events:
+                            watch_from = max(watch_from,
+                                             batch.events[-1].revision)
+                    batch = watch.get(timeout=0.0)
+            # -- utilization: what the autoscaler's collector digests
+            try:
+                world, generation = _cluster_world(store, args.job)
+                rate = 50.0 * (world ** 0.7) if world else 0.0
+                store.put(util_key(args.job, args.pod_id), json.dumps({
+                    "examples_per_sec": round(rate, 3),
+                    "world_size": world or None,
+                    "generation": generation,
+                    "published_unix": time.time(),
+                    "pod_id": args.pod_id}),
+                    lease=register.lease or 0)
+            except (EdlError, OSError) as exc:
+                report("typed_error", where="util", error=str(exc))
+            # -- checkpoint plane
+            now = time.monotonic()
+            if now - last_seal >= args.seal_every:
+                last_seal = now
+                rig.seal()
+            if now - last_verify >= args.verify_every:
+                last_verify = now
+                rig.verify_all()
+            if stop["flag"]:
+                break
+            time.sleep(args.interval)
+    finally:
+        if watch is not None:
+            watch.cancel()
+        try:
+            register.release()
+        except (EdlError, OSError):
+            pass
+        report("stopped", graceful=True)
+        report.close()
+        watch_client.close()
+        store.close()
+    return 0
+
+
+def _sleep(backoff: Backoff, stop: dict) -> bool:
+    time.sleep(min(backoff.delay(), 1.0))
+    return stop["flag"]
+
+
+def _cluster_world(store: StoreClient, job_id: str
+                   ) -> tuple[int, int | None]:
+    rec = store.get(reg.cluster_key(job_id))
+    if rec is None:
+        return 0, None
+    cluster = Cluster.from_json(rec.value)
+    return cluster.world_size, cluster.version
+
+
+def add_worker_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--endpoints", required=True)
+    parser.add_argument("--job", required=True)
+    parser.add_argument("--pod-id", required=True)
+    parser.add_argument("--slot", type=int, required=True)
+    parser.add_argument("--report", required=True)
+    parser.add_argument("--ckpt-dir", required=True)
+    parser.add_argument("--max-nodes", type=int, default=8)
+    parser.add_argument("--ttl", type=float, default=2.0)
+    parser.add_argument("--interval", type=float, default=0.15)
+    parser.add_argument("--seal-every", type=float, default=1.2)
+    parser.add_argument("--verify-every", type=float, default=0.8)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="edl_tpu.chaos.worker")
+    add_worker_args(parser)
+    return run_worker(parser.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

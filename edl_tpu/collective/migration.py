@@ -53,6 +53,7 @@ from typing import Any, Callable
 import numpy as np
 
 from edl_tpu.coord.store import Store
+from edl_tpu.train.ckpt_io import chunk_crc32, verify_enabled
 from edl_tpu.utils import config
 from edl_tpu.data.tensor_wire import (TensorWireError, recv_tensors,
                                          send_tensors)
@@ -242,12 +243,18 @@ class _PeerChunks:
     `_ChunkFiles` handle cache."""
 
     def __init__(self, owners: dict[str, dict], timeout: float,
-                 expect_version: int | None = None):
+                 expect_version: int | None = None,
+                 crcs: dict[str, int] | None = None):
         self.owners = owners            # chunk fname -> donor advert
         self.timeout = timeout
         # version fence: a donor sealing a NEWER snapshot mid-restore
         # must not mix steps into the assembled state
         self.expect_version = expect_version
+        # integrity fence: chunk crc32s from the donor manifests — a
+        # chunk garbled on the wire (or served torn) fails here and the
+        # whole peer restore falls back instead of assembling garbage
+        self.crcs = crcs or {}
+        self._verify = verify_enabled()
         self._cache: dict[str, np.ndarray] = {}
         self._cache_lock = threading.Lock()
         self._inflight: dict[str, threading.Lock] = {}
@@ -302,6 +309,14 @@ class _PeerChunks:
                 f"{meta.get('version')} mid-restore (wanted "
                 f"{self.expect_version})")
         arr = tensors[fname]
+        expect = self.crcs.get(fname)
+        if self._verify and expect is not None:
+            got = chunk_crc32(arr)
+            if got != expect:
+                raise PeerRestoreError(
+                    f"chunk {fname} from donor {advert.get('pod_id')} "
+                    f"failed integrity check (crc32 {got:#010x} != "
+                    f"manifest {expect:#010x})")
         with self._cache_lock:
             self._cache[fname] = arr
             self.bytes_fetched += arr.nbytes
@@ -372,7 +387,8 @@ def restore_from_peers(store: Store, job_id: str, target: Any, *,
             for chunk in leaf["chunks"]:
                 owners.setdefault(chunk["file"], advert)
     merged = sc.merge_leaf_tables([m["leaves"] for m in manifests.values()])
-    source = _PeerChunks(owners, timeout, expect_version=chosen)
+    source = _PeerChunks(owners, timeout, expect_version=chosen,
+                         crcs=sc.checksum_map(merged))
     t0 = time.perf_counter()
     try:
         state = sc.restore_from_index(merged, source.load, target, threads)

@@ -71,6 +71,43 @@ def release_trainer(tp: TrainerProc) -> None:
         pass
 
 
+def _signal_group(tp: TrainerProc, sig: signal.Signals) -> bool:
+    """Deliver ``sig`` to the trainer's whole process group; False when
+    the group is already gone. The chaos plane's process injector uses
+    this for its SIGKILL/SIGSTOP/SIGCONT faults — the group, not the
+    pid, so a paused trainer cannot keep live grandchildren serving."""
+    if not tp.alive():
+        return False
+    try:
+        os.killpg(os.getpgid(tp.pid), sig)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def kill_trainer(tp: TrainerProc) -> bool:
+    """SIGKILL, no grace — the crash fault (vs `terminate_trainer`'s
+    graceful escalation)."""
+    ok = _signal_group(tp, signal.SIGKILL)
+    if ok:
+        try:
+            tp.proc.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            log.error("trainer pid=%d survived SIGKILL", tp.pid)
+    return ok
+
+
+def pause_trainer(tp: TrainerProc) -> bool:
+    """SIGSTOP the group (the grey-failure fault: alive to the OS, dead
+    to every deadline)."""
+    return _signal_group(tp, signal.SIGSTOP)
+
+
+def resume_trainer(tp: TrainerProc) -> bool:
+    """SIGCONT a paused group."""
+    return _signal_group(tp, signal.SIGCONT)
+
+
 def terminate_trainer(tp: TrainerProc, grace: float = 10.0) -> None:
     """SIGTERM the process group, escalate to SIGKILL after `grace`."""
     if not tp.alive():

@@ -217,12 +217,13 @@ class _ElectClient(StoreClient):
 
     def __init__(self, node: "ReplicaNode", endpoint: str, ttl: float):
         self._node = node
+        self._peer = endpoint
         super().__init__(endpoint, timeout=max(0.2, min(1.0, ttl / 2.0)),
                          connect_retries=1, retry_interval=0.05)
 
     def _call(self, **req) -> dict:
-        if self._node._partitioned:
-            raise EdlStoreError("partitioned (chaos test hook)")
+        if self._node._blocked(self._peer):
+            raise EdlStoreError("partitioned (chaos hook)")
         req["elect_space"] = True
         return super()._call(**req)
 
@@ -344,7 +345,13 @@ class ReplicaNode:
         self._pending: dict[str, bool] = {p: False for p in self.peers}  # guarded-by: _wake_cond
 
         self._elect_clients: dict[str, _ElectClient] = {}
-        self._partitioned = False  # chaos test hook: drop peer traffic
+        # Chaos partition hook: False = healthy, True = severed from ALL
+        # peers (the asymmetric partition: clients still reach this
+        # node's server socket, but it cannot reach quorum), or a
+        # frozenset of peer endpoints to sever selectively. Inbound
+        # peer traffic from a severed endpoint is refused too, so a
+        # partition is symmetric per-link.
+        self._partition: frozenset[str] | bool = False
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.store.set_passive(True)
@@ -384,6 +391,40 @@ class ReplicaNode:
 
     def kill(self) -> None:
         self.stop(graceful=False)
+
+    # -- chaos partition hook ------------------------------------------------
+
+    def set_partition(self, peers: bool | list[str] | None) -> None:
+        """Sever (or heal, with None/False) this node's peer links:
+        True drops traffic to/from every peer, a list severs only those
+        endpoints. Client connections to this node's own server socket
+        are untouched — combining ``set_partition(True)`` on a leader
+        with a client pinned to it is the asymmetric partition drill
+        (reachable deposed leader, unreachable quorum)."""
+        if peers is None or peers is False:
+            self._partition = False
+        elif peers is True:
+            self._partition = True
+        else:
+            self._partition = frozenset(peers)
+
+    def _blocked(self, peer: str | None) -> bool:
+        part = self._partition
+        if part is False:
+            return False
+        if part is True:
+            return True
+        return peer is not None and peer in part
+
+    # Legacy chaos hook spelling (tests set it directly): truthiness
+    # maps onto the all-peers partition.
+    @property
+    def _partitioned(self) -> bool:
+        return bool(self._partition)
+
+    @_partitioned.setter
+    def _partitioned(self, value: bool) -> None:
+        self.set_partition(bool(value))
 
     def sweep(self) -> None:
         """Called by the hosting StoreServer's sweeper: the election
@@ -641,7 +682,7 @@ class ReplicaNode:
                 self._pending[peer] = False
             if self._stop.is_set():
                 break
-            if self.role() != "leader" or self._partitioned:
+            if self.role() != "leader" or self._blocked(peer):
                 _drop()
                 continue
             try:
@@ -724,8 +765,8 @@ class ReplicaNode:
         return wire.recv_msg(sock)
 
     def _peer_call(self, endpoint: str, msg: dict, timeout: float) -> dict:
-        if self._partitioned:
-            raise EdlStoreError("partitioned (chaos test hook)")
+        if self._blocked(endpoint):
+            raise EdlStoreError("partitioned (chaos hook)")
         sock = socket.create_connection(split_endpoint(endpoint),
                                         timeout=timeout)
         try:
@@ -822,17 +863,13 @@ class ReplicaNode:
                 return {"ok": False,
                         "error": f"op {op!r} unsupported in elect space"}
             return _Handler._dispatch(self.elect, sub)
-        if op == "repl_probe":
-            if self._partitioned:
+        if op in ("repl_probe", "repl_append", "repl_snapshot"):
+            if self._blocked(str(req.get("leader") or "") or None):
                 return {"ok": False, "error": "partitioned (chaos hook)"}
-            return self._handle_probe(req)
-        if op == "repl_append":
-            if self._partitioned:
-                return {"ok": False, "error": "partitioned (chaos hook)"}
-            return self._handle_append(req)
-        if op == "repl_snapshot":
-            if self._partitioned:
-                return {"ok": False, "error": "partitioned (chaos hook)"}
+            if op == "repl_probe":
+                return self._handle_probe(req)
+            if op == "repl_append":
+                return self._handle_append(req)
             return self._handle_snapshot(req)
         if op == "status":
             return self.status_doc()

@@ -57,6 +57,8 @@ import socket
 import struct
 from typing import Any
 
+from edl_tpu.utils import config
+
 MAGIC = b"EDL1"
 _HEADER = struct.Struct(">4sI")
 MAX_BODY = 64 * 1024 * 1024
@@ -66,28 +68,87 @@ class WireError(ConnectionError):
     pass
 
 
+# Chaos seam (edl_tpu/chaos/faults.py): an installed hook sees every
+# frame at THIS boundary — send side before bytes leave, recv side after
+# the body arrives — and may delay (sleep), drop (raise WireError),
+# hard-close the socket, or garble the received bytes. The hook lives at
+# the wire module, not monkeypatched into callers, so every consumer of
+# the framed protocol (store client/server, replication senders,
+# election sidecars) is faultable through one switch.
+_fault_hook = None
+
+
+def install_fault_hook(hook):
+    """Install (or clear, with None) the wire fault hook; returns the
+    previous hook so a scoped injector can restore it."""
+    global _fault_hook
+    prev, _fault_hook = _fault_hook, hook
+    return prev
+
+
+def stall_timeout() -> float:
+    """Mid-frame stall deadline in seconds (EDL_TPU_WIRE_STALL_S; <=0
+    disables). IDLE sockets may block per their own timeout policy —
+    request/response connections legitimately sit quiet — but once a
+    frame has started arriving, the rest must keep flowing: a peer that
+    stalls mid-frame (SIGSTOP, half-open TCP, a chaos injector) becomes
+    a typed WireError instead of a wedged consumer thread."""
+    return config.env_float("EDL_TPU_WIRE_STALL_S", 60.0)
+
+
 def send_msg(sock: socket.socket, msg: dict[str, Any]) -> None:
     body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    hook = _fault_hook
+    if hook is not None:
+        hook.on_send(sock, _HEADER.size + len(body))
     sock.sendall(_HEADER.pack(MAGIC, len(body)) + body)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+def _recv_exact(sock: socket.socket, n: int, *, stall: float = 0.0,
+                mid_frame: bool = False) -> bytes:
+    """Read exactly ``n`` bytes. With ``stall`` > 0, bytes after the
+    first (or ALL bytes when ``mid_frame`` — the frame started in an
+    earlier read) must each arrive within ``stall`` seconds; a socket
+    whose own timeout is already tighter keeps it."""
     buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise WireError("peer closed connection")
-        buf.extend(chunk)
+    prev = sock.gettimeout()
+    bounded = False
+    try:
+        while len(buf) < n:
+            want_bound = stall > 0 and (mid_frame or buf) \
+                and (prev is None or prev > stall)
+            if want_bound != bounded:
+                sock.settimeout(stall if want_bound else prev)
+                bounded = want_bound
+            try:
+                chunk = sock.recv(n - len(buf))
+            except TimeoutError as exc:
+                if bounded:
+                    raise WireError(
+                        f"peer stalled mid-frame ({len(buf)}/{n} bytes "
+                        f"after {stall:.0f}s)") from exc
+                raise
+            if not chunk:
+                raise WireError("peer closed connection")
+            buf.extend(chunk)
+    finally:
+        if bounded:
+            sock.settimeout(prev)
     return bytes(buf)
 
 
 def recv_msg(sock: socket.socket) -> dict[str, Any]:
-    magic, length = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    stall = stall_timeout()
+    magic, length = _HEADER.unpack(
+        _recv_exact(sock, _HEADER.size, stall=stall))
     if magic != MAGIC:
         raise WireError(f"bad magic {magic!r}")
     if length > MAX_BODY:
         raise WireError(f"frame too large: {length}")
-    body = _recv_exact(sock, length)
+    body = _recv_exact(sock, length, stall=stall, mid_frame=True)
+    hook = _fault_hook
+    if hook is not None:
+        body = hook.on_recv(sock, body, "body")
     try:
         return json.loads(body)
     except (ValueError, UnicodeDecodeError) as exc:

@@ -28,6 +28,8 @@ from typing import Any
 
 import numpy as np
 
+from edl_tpu.utils import config
+
 MAGIC = b"EDT1"
 _HEADER = struct.Struct(">4sI")
 MAX_HEADER = 4 * 1024 * 1024
@@ -38,13 +40,59 @@ class TensorWireError(ConnectionError):
     pass
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
+# Chaos seam, mirroring coord/wire.py: an installed hook may delay,
+# drop (raise), hard-close, or garble frames at this boundary — the one
+# switch that faults the teacher RPCs, the data server, and p2p state
+# migration alike (whose chunk crc32s are exactly what a payload garble
+# exercises).
+_fault_hook = None
+
+
+def install_fault_hook(hook):
+    """Install (or clear, with None) the tensor-wire fault hook;
+    returns the previous hook so a scoped injector can restore it."""
+    global _fault_hook
+    prev, _fault_hook = _fault_hook, hook
+    return prev
+
+
+def stall_timeout() -> float:
+    """Mid-frame stall deadline (EDL_TPU_WIRE_STALL_S, shared with the
+    framed-JSON control wire; <=0 disables). Idle connections may block
+    per their own timeout policy, but once a frame has started, every
+    subsequent recv must produce bytes within this bound — a stalled
+    peer becomes a typed TensorWireError, never a wedged server
+    thread. The bound is per-recv (progress resets it), so a slow but
+    moving bulk transfer is never killed mid-flight."""
+    return config.env_float("EDL_TPU_WIRE_STALL_S", 60.0)
+
+
+def _recv_exact(sock: socket.socket, n: int, *, stall: float = 0.0,
+                mid_frame: bool = False) -> bytes:
     buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(n - len(buf), 1 << 20))
-        if not chunk:
-            raise TensorWireError("peer closed connection")
-        buf.extend(chunk)
+    prev = sock.gettimeout()
+    bounded = False
+    try:
+        while len(buf) < n:
+            want_bound = stall > 0 and (mid_frame or buf) \
+                and (prev is None or prev > stall)
+            if want_bound != bounded:
+                sock.settimeout(stall if want_bound else prev)
+                bounded = want_bound
+            try:
+                chunk = sock.recv(min(n - len(buf), 1 << 20))
+            except TimeoutError as exc:
+                if bounded:
+                    raise TensorWireError(
+                        f"peer stalled mid-frame ({len(buf)}/{n} bytes "
+                        f"after {stall:.0f}s)") from exc
+                raise
+            if not chunk:
+                raise TensorWireError("peer closed connection")
+            buf.extend(chunk)
+    finally:
+        if bounded:
+            sock.settimeout(prev)
     return bytes(buf)
 
 
@@ -101,18 +149,28 @@ def send_tensors(sock: socket.socket, meta: dict[str, Any],
                         separators=(",", ":")).encode("utf-8")
     if len(header) > MAX_HEADER:
         raise TensorWireError(f"header too large: {len(header)}")
+    hook = _fault_hook
+    if hook is not None:
+        hook.on_send(sock, _HEADER.size + len(header)
+                     + sum(memoryview(p).nbytes for p in payloads))
     _send_gather(sock, [_HEADER.pack(MAGIC, len(header)), header, *payloads])
 
 
 def recv_tensors(sock: socket.socket
                  ) -> tuple[dict[str, Any], dict[str, np.ndarray]]:
-    magic, hlen = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    stall = stall_timeout()
+    magic, hlen = _HEADER.unpack(
+        _recv_exact(sock, _HEADER.size, stall=stall))
     if magic != MAGIC:
         raise TensorWireError(f"bad magic {magic!r}")
     if hlen > MAX_HEADER:
         raise TensorWireError(f"header too large: {hlen}")
+    hook = _fault_hook
     try:
-        header = json.loads(_recv_exact(sock, hlen))
+        hbytes = _recv_exact(sock, hlen, stall=stall, mid_frame=True)
+        if hook is not None:
+            hbytes = hook.on_recv(sock, hbytes, "header")
+        header = json.loads(hbytes)
         meta = header["meta"]
         descs = header["tensors"]
     except (ValueError, KeyError, UnicodeDecodeError) as exc:
@@ -129,6 +187,8 @@ def recv_tensors(sock: socket.socket
         total += nbytes
         if total > MAX_PAYLOAD:
             raise TensorWireError(f"payload too large: {total}")
-        buf = _recv_exact(sock, nbytes)
+        buf = _recv_exact(sock, nbytes, stall=stall, mid_frame=True)
+        if hook is not None:
+            buf = hook.on_recv(sock, buf, "payload")
         tensors[d["name"]] = np.frombuffer(buf, dtype=dtype).reshape(shape)
     return meta, tensors

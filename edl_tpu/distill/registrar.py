@@ -29,6 +29,7 @@ from edl_tpu.coord.redis_store import connect_store
 from edl_tpu.coord.registry import Registration, ServiceRegistry
 from edl_tpu.coord.store import Store
 from edl_tpu.utils import net
+from edl_tpu.utils.backoff import Backoff
 from edl_tpu.utils.exceptions import EdlRegisterError
 from edl_tpu.utils.logging import get_logger
 
@@ -58,11 +59,17 @@ class TeacherRegistrar:
         self._last_stats: dict | None = None
 
     def wait_alive(self) -> None:
+        # jittered-exponential probing (utils/backoff.py): a pool of
+        # registrars waiting out one slow teacher must not re-probe in
+        # lockstep, and the deadline keeps a never-up server a typed
+        # error instead of a forever-wedge
+        backoff = Backoff(base=self.probe_interval,
+                          max_delay=max(self.probe_interval, 2.0))
         deadline = time.monotonic() + self.probe_timeout
         while time.monotonic() < deadline:
             if net.is_endpoint_alive(self.server):
                 return
-            time.sleep(self.probe_interval)
+            backoff.sleep()
         raise EdlRegisterError(
             f"teacher {self.server} not answering after {self.probe_timeout}s")
 

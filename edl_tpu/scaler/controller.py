@@ -44,6 +44,7 @@ from edl_tpu.coord.collector import Collector
 from edl_tpu.coord.store import Store
 from edl_tpu.scaler.policy import JobView, Proposal, ScalingPolicy
 from edl_tpu.utils.config import field
+from edl_tpu.utils.exceptions import EdlStoreError
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.scaler.controller")
@@ -136,15 +137,25 @@ class DecisionJournal:
     def append(self, entry: dict) -> dict:
         entry = dict(entry, seq=self._seq)
         line = json.dumps(entry, sort_keys=True)
-        if self.store is not None:
-            prefix = journal_prefix(self.scope)
-            self.store.put(f"{prefix}{self._seq:010d}", line)
-            drop = self._seq - self.keep
-            if drop >= 0:
-                self.store.delete(f"{prefix}{drop:010d}")
+        # File first: the local JSONL is the durable audit trail (the
+        # chaos soak's journal<->resize_log invariant reads it), so a
+        # store outage between an actuated resize and its journal entry
+        # must not lose the record. The store copy is the takeover
+        # leader's replay source — best-effort; a missed entry costs a
+        # cooldown resume at worst and heals on the next append.
         if self._fh is not None:
             self._fh.write(line + "\n")
             self._fh.flush()
+        if self.store is not None:
+            prefix = journal_prefix(self.scope)
+            try:
+                self.store.put(f"{prefix}{self._seq:010d}", line)
+                drop = self._seq - self.keep
+                if drop >= 0:
+                    self.store.delete(f"{prefix}{drop:010d}")
+            except EdlStoreError as exc:
+                log.warning("journal entry %d not mirrored to the store "
+                            "(%s) — file journal has it", self._seq, exc)
         self._seq += 1
         return entry
 
@@ -572,7 +583,17 @@ class ScalerController:
             while not self._stop.is_set():
                 if self.election is not None \
                         and not self.election.is_leader():
-                    if not self.election.campaign(timeout=1.0):
+                    try:
+                        won = self.election.campaign(timeout=1.0)
+                    except EdlStoreError as exc:
+                        # store outage mid-campaign (leader failover,
+                        # partition): the scaler must outlive it and
+                        # re-campaign, not die silently
+                        log.warning("scaler campaign failed: %s", exc)
+                        if self._stop.wait(timeout=1.0):
+                            break
+                        continue
+                    if not won:
                         continue
                     log.info("scaler leadership acquired (%s)", self.owner)
                     self._restored = False  # re-replay on every takeover

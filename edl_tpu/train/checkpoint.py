@@ -826,7 +826,39 @@ class CheckpointManager:
         chunk regions are read through a per-file handle cache on a
         thread pool (``EDL_TPU_CKPT_RESTORE_THREADS``) — restore wall
         time is the elastic-downtime term this call owns.
+
+        Integrity: a chunk failing its sealed crc32 raises the typed
+        ``EdlCheckpointCorrupt``; with ``version=None`` the manager
+        falls back to the next older sealed version (loudly) instead of
+        loading garbage — only an explicit ``version`` surfaces the
+        corruption to the caller.
         """
+        from edl_tpu.utils.exceptions import EdlCheckpointCorrupt
+        if version is not None:
+            return self._restore_version(target, version)
+        try:
+            return self._restore_version(target, None)
+        except EdlCheckpointCorrupt as exc:
+            last_exc = exc
+        # The auto-picked latest (mirror fetches land locally first, so
+        # latest_version() names it) is corrupt: walk older sealed
+        # versions, newest first, loudly.
+        bad = self.latest_version()
+        log.error("checkpoint ckpt-%s corrupt (%s) — falling back to "
+                  "the previous sealed version", bad, last_exc)
+        older = [v for v in self.versions() if bad is None or v < bad]
+        for v in reversed(older):
+            try:
+                return self._restore_version(target, v)
+            except EdlCheckpointCorrupt as exc:
+                last_exc = exc
+                log.error("checkpoint ckpt-%d also corrupt (%s)", v, exc)
+        raise EdlCheckpointCorrupt(
+            "every local sealed checkpoint failed its integrity check "
+            f"under {self.directory}") from last_exc
+
+    def _restore_version(self, target: Any, version: int | None
+                         ) -> tuple[Any, TrainStatus] | None:
         t_start = time.perf_counter()
         if version is None:
             version = self.latest_version()

@@ -23,6 +23,18 @@ np.load'ed once per restore, not once per intersecting region) and, when
 assembly — elastic re-formation wants the restore off the downtime
 budget as much as the save off the step loop.
 
+Integrity: ``write_snapshot`` records a crc32 per chunk in the index;
+restore verifies each chunk file once on first load (disk) — and the
+migration plane verifies peer-fetched chunks against the donor
+manifest's same numbers — raising the typed ``EdlCheckpointCorrupt``
+so callers fall back (previous sealed version / another donor) instead
+of loading garbage. ``EDL_TPU_CKPT_VERIFY=0`` disables.
+
+The numpy-only file halves (chunk naming, crc, write, merge, region
+assembly) live in ``train/ckpt_io.py`` so jax-free consumers — the
+chaos plane's corruptor and soak workers — speak the same format; this
+module re-exports them for compatibility and keeps the jax halves.
+
 Layout inside a checkpoint directory:
   leaf{i}-o{start}_{start}...npy   one file per unique array chunk
   index.{process}.json             that process's chunk table + leaf specs
@@ -33,43 +45,37 @@ auto-detect it next to the replicated msgpack format.
 
 from __future__ import annotations
 
-import glob
-import json
 import os
-import re
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any
 
 import jax
 import numpy as np
 
+from edl_tpu.train import ckpt_io
+from edl_tpu.train.ckpt_io import (  # noqa: F401 — compat re-exports
+    ChunkFiles as _ChunkFiles,
+    checksum_map,
+    chunk_crc32,
+    is_sharded_dir,
+    merge_leaf_tables,
+    read_region as _read_region,
+    verify_enabled,
+    write_snapshot,
+)
 from edl_tpu.utils import config
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.train.sharded_checkpoint")
 
-_INDEX_RE = re.compile(r"^index\.(\d+)\.json$")
+_INDEX_RE = ckpt_io._INDEX_RE
+_chunk_name = ckpt_io.chunk_name
+_slices_to_offset_shape = ckpt_io.slices_to_offset_shape
+_merged_index = ckpt_io.read_merged_index
 
 
 def _leaf_key(path) -> str:
     return jax.tree_util.keystr(path)
-
-
-def _chunk_name(leaf_i: int, offset: tuple[int, ...]) -> str:
-    tag = "_".join(str(o) for o in offset) if offset else "scalar"
-    return f"leaf{leaf_i}-o{tag}.npy"
-
-
-def _slices_to_offset_shape(index: tuple, shape: tuple[int, ...]
-                            ) -> tuple[tuple[int, ...], tuple[int, ...]]:
-    offset, size = [], []
-    for sl, dim in zip(index, shape):
-        start = 0 if sl.start is None else int(sl.start)
-        stop = dim if sl.stop is None else int(sl.stop)
-        offset.append(start)
-        size.append(stop - start)
-    return tuple(offset), tuple(size)
 
 
 def snapshot_shards(state: Any) -> dict:
@@ -117,26 +123,6 @@ def snapshot_shards(state: Any) -> dict:
             "process_index": jax.process_index()}
 
 
-def write_snapshot(directory: str, snap: dict) -> list[str]:
-    """Write a ``snapshot_shards`` result into ``directory``.
-
-    The disk half of ``save_sharded`` — safe to run on a background
-    thread (pure numpy + file I/O, no device access). Returns the
-    basenames this process wrote (chunks + its index file), index last
-    so its presence implies the chunks made it.
-    """
-    os.makedirs(directory, exist_ok=True)
-    written: list[str] = []
-    for fname, arr in snap["chunks"]:
-        np.save(os.path.join(directory, fname), arr)
-        written.append(fname)
-    index_name = f"index.{snap['process_index']}.json"
-    with open(os.path.join(directory, index_name), "w") as f:
-        json.dump({"leaves": snap["leaves"]}, f)
-    written.append(index_name)
-    return written
-
-
 def save_sharded(directory: str, state: Any) -> list[str]:
     """Write this process's unique shards of `state` into `directory`.
 
@@ -158,8 +144,9 @@ def snapshot_host_tree(state: Any) -> dict:
     process 0. This is what lets the state-migration plane serve
     replicated AND sharded snapshots through one region planner —
     a peer restoring from a replicated donor plans regions against this
-    table exactly as it would against on-disk chunk indexes.
-    """
+    table exactly as it would against on-disk chunk indexes. Chunk
+    crc32s are recorded here (not only at write time) so a replicated
+    donor's manifest carries checksums for the peer-fetch verify."""
     leaves = jax.tree_util.tree_flatten_with_path(state)[0]
     chunks_out: list[tuple[str, np.ndarray]] = []
     table = []
@@ -172,98 +159,9 @@ def snapshot_host_tree(state: Any) -> dict:
                       "dtype": str(arr.dtype),
                       "chunks": [{"offset": list(offset),
                                   "shape": list(arr.shape),
-                                  "file": fname}]})
+                                  "file": fname,
+                                  "crc32": chunk_crc32(arr)}]})
     return {"leaves": table, "chunks": chunks_out, "process_index": 0}
-
-
-def merge_leaf_tables(tables: list[list[dict]]) -> dict[str, dict]:
-    """key -> {shape, dtype, chunks[]} merged across per-process leaf
-    tables (the `leaves` list of an index file, a `snapshot_shards`
-    result, or a migration donor's manifest)."""
-    merged: dict[str, dict] = {}
-    for leaves in tables:
-        for leaf in leaves:
-            entry = merged.setdefault(
-                leaf["key"], {"shape": leaf["shape"], "dtype": leaf["dtype"],
-                              "chunks": []})
-            if entry["shape"] != leaf["shape"]:
-                raise ValueError(
-                    f"shape mismatch across leaf tables for {leaf['key']}")
-            entry["chunks"].extend(leaf["chunks"])
-    return merged
-
-
-def _merged_index(directory: str) -> dict[str, dict]:
-    """key -> {shape, dtype, chunks[]} merged across all process indexes."""
-    paths = glob.glob(os.path.join(directory, "index.*.json"))
-    if not paths:
-        raise FileNotFoundError(f"no index.*.json under {directory}")
-    tables = []
-    for p in sorted(paths):
-        with open(p) as f:
-            tables.append(json.load(f)["leaves"])
-    return merge_leaf_tables(tables)
-
-
-class _ChunkFiles:
-    """Per-restore cache of memory-mapped chunk files.
-
-    A resharding restore reads the same chunk for every target region it
-    intersects; re-running np.load per region paid a file open + header
-    parse each time. One handle per file, shared across regions (and
-    across reader threads — numpy memmap reads are thread-safe)."""
-
-    def __init__(self, directory: str):
-        self.directory = directory
-        self._handles: dict[str, np.ndarray] = {}
-        self._lock = threading.Lock()
-
-    def load(self, fname: str) -> np.ndarray:
-        with self._lock:
-            h = self._handles.get(fname)
-            if h is None:
-                h = np.load(os.path.join(self.directory, fname),
-                            mmap_mode="r")
-                self._handles[fname] = h
-            return h
-
-    def close(self) -> None:
-        self._handles.clear()  # memmaps close when the views are collected
-
-
-def _read_region(load, entry: dict, index: tuple) -> np.ndarray:
-    """Assemble the region `index` (tuple of slices) from saved chunks.
-
-    ``load(fname) -> ndarray`` is the chunk source — a `_ChunkFiles`
-    mmap cache for on-disk checkpoints, or a peer-fetch cache when the
-    chunks live in a migration donor's memory."""
-    shape = tuple(entry["shape"])
-    offset, size = _slices_to_offset_shape(index, shape)
-    out = np.empty(size, dtype=np.dtype(entry["dtype"]))
-    # Coverage mask (not an element count): overlapping chunks — e.g. a
-    # half-written dir mixing two world shapes — must not mask a hole.
-    covered = np.zeros(size, dtype=bool)
-    for chunk in entry["chunks"]:
-        coff, cshape = chunk["offset"], chunk["shape"]
-        lo = [max(o, co) for o, co in zip(offset, coff)]
-        hi = [min(o + s, co + cs)
-              for o, s, co, cs in zip(offset, size, coff, cshape)]
-        if any(a >= b for a, b in zip(lo, hi)):
-            continue
-        src = load(chunk["file"])
-        src_sel = tuple(slice(a - co, b - co)
-                        for a, b, co in zip(lo, hi, coff))
-        dst_sel = tuple(slice(a - o, b - o)
-                        for a, b, o in zip(lo, hi, offset))
-        out[dst_sel] = src[src_sel]
-        covered[dst_sel] = True
-    if not covered.all():
-        missing = int(covered.size - np.count_nonzero(covered))
-        raise ValueError(
-            f"chunks leave {missing}/{covered.size} elements of region "
-            f"{offset}+{size} unwritten — checkpoint incomplete for this "
-            f"resharding")
-    return out
 
 
 def restore_threads() -> int:
@@ -293,12 +191,15 @@ def restore_sharded(directory: str, target: Any,
     ``threads``: region-read pool width (default `restore_threads()`,
     env ``EDL_TPU_CKPT_RESTORE_THREADS``); every unique target region is
     prefetched concurrently before device placement, and 1 keeps the
-    serial path.
+    serial path. Chunk integrity is verified against the index's sealed
+    crc32s (``EDL_TPU_CKPT_VERIFY``); corruption raises
+    ``EdlCheckpointCorrupt`` — CheckpointManager.restore falls back to
+    the previous sealed version on it.
     """
-    files = _ChunkFiles(directory)
+    merged = _merged_index(directory)
+    files = _ChunkFiles(directory, crcs=checksum_map(merged))
     try:
-        return restore_from_index(_merged_index(directory), files.load,
-                                  target, threads)
+        return restore_from_index(merged, files.load, target, threads)
     finally:
         files.close()
 
@@ -384,10 +285,3 @@ def restore_from_index(merged: dict[str, dict], load, target: Any,
             full = regions[(id(entry), _region_key(idxs[0], shape))]
             out.append(full if shape else full[()])
     return jax.tree_util.tree_unflatten(treedef, out)
-
-
-def is_sharded_dir(directory: str) -> bool:
-    try:
-        return any(_INDEX_RE.match(n) for n in os.listdir(directory))
-    except FileNotFoundError:
-        return False

@@ -76,6 +76,7 @@ ENV_VARS: dict[str, str] = {
                                      "(reference env-name parity)",
     "EDL_TPU_SAVE_CHECKPOINT_INTER": "save every N epochs",
     "EDL_TPU_CKPT_RESTORE_THREADS": "parallel restore read threads",
+    "EDL_TPU_CKPT_VERIFY": "chunk crc32 verification on restore (0 = off)",
     "EDL_TPU_COMPILE_CACHE_DIR": "persistent XLA compilation cache dir",
     # -- p2p live state migration ------------------------------------------
     "EDL_TPU_RESIZE_P2P": "peer-to-peer live state migration (0 = "
@@ -134,6 +135,9 @@ ENV_VARS: dict[str, str] = {
     # -- analysis plane -----------------------------------------------------
     "EDL_TPU_LOCKGRAPH": "lock-order race detector during pytest (1 = on)",
     "EDL_TPU_LOCKGRAPH_OUT": "lockgraph JSON report path",
+    # -- chaos plane ---------------------------------------------------------
+    "EDL_TPU_WIRE_STALL_S": "mid-frame wire stall deadline seconds "
+                            "(<=0 disables)",
 }
 
 

@@ -31,3 +31,10 @@ class EdlLeaseExpired(EdlStoreError):
 
 class EdlDataError(EdlError):
     """Data pipeline / task dispenser error."""
+
+
+class EdlCheckpointCorrupt(EdlError):
+    """A checkpoint chunk failed its integrity check (crc32 recorded at
+    seal time, verified on restore — disk and peer paths alike). Typed
+    so restore paths can fall back to the previous sealed version or
+    another donor instead of loading garbage."""

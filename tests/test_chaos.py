@@ -1,0 +1,339 @@
+"""Chaos plane: schedule replay, injectors, integrity, audits.
+
+The full soak is a CI step (``python -m edl_tpu.chaos soak``); these
+tests pin the pieces fast: seed-exact schedules, the wire fault hook
+and stall deadline at both wire seams, the checkpoint corruptor vs the
+crc integrity path (ckpt_io AND the jax CheckpointManager fallback),
+the worker's seal/verify/quarantine rig, and the auditor's judgment on
+synthetic artifacts.
+"""
+
+import json
+import os
+import random
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from edl_tpu.chaos.audit import ChaosReport, InvariantAuditor, load_jsonl
+from edl_tpu.chaos.faults import (CheckpointCorruptor, WireChaos,
+                                  _npy_data_offset)
+from edl_tpu.chaos.schedule import FAULT_CLASSES, ChaosSchedule
+from edl_tpu.coord import wire
+from edl_tpu.data import tensor_wire
+from edl_tpu.train import ckpt_io
+from edl_tpu.utils.exceptions import EdlCheckpointCorrupt
+
+
+# -- schedule ---------------------------------------------------------------
+
+def test_schedule_is_seed_exact():
+    a = ChaosSchedule.generate(7, 30, tick_s=1.0, pods=3)
+    b = ChaosSchedule.generate(7, 30, tick_s=1.0, pods=3)
+    assert a.fingerprint() == b.fingerprint()
+    assert [e.to_dict() for e in a] == [e.to_dict() for e in b]
+    c = ChaosSchedule.generate(8, 30, tick_s=1.0, pods=3)
+    assert c.fingerprint() != a.fingerprint()
+
+
+def test_schedule_head_spans_every_class():
+    sched = ChaosSchedule.generate(1, len(FAULT_CLASSES), pods=2)
+    assert sched.classes() == set(FAULT_CLASSES)
+    # times strictly ordered and non-negative
+    times = [e.t for e in sched]
+    assert times == sorted(times) and times[0] > 0
+
+
+# -- wire fault hook --------------------------------------------------------
+
+@pytest.fixture
+def sock_pair():
+    a, b = socket.socketpair()
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_wire_chaos_drop_and_garble(sock_pair):
+    a, b = sock_pair
+    chaos = WireChaos(seed=1, modes=("drop",), rate=1.0)
+    with chaos:
+        with pytest.raises(ConnectionError):
+            wire.send_msg(a, {"op": "ping"})
+    # uninstalled: the same send goes through and garble-on-read only
+    # fires while a garbling hook is installed
+    wire.send_msg(a, {"op": "ping"})
+    assert wire.recv_msg(b) == {"op": "ping"}
+    with WireChaos(seed=2, modes=("garble",), rate=1.0):
+        wire.send_msg(a, {"op": "ping"})
+        with pytest.raises(wire.WireError, match="malformed"):
+            wire.recv_msg(b)
+
+
+def test_tensor_wire_garble_is_typed(sock_pair):
+    a, b = sock_pair
+    arr = np.arange(16, dtype=np.float32)
+    with WireChaos(seed=3, modes=("garble",), rate=1.0):
+        tensor_wire.send_tensors(a, {"op": "x"}, {"t": arr})
+        # a garbled frame poisons the CONNECTION (consumers drop it and
+        # reconnect — exactly what real corruption would force)
+        with pytest.raises(tensor_wire.TensorWireError):
+            tensor_wire.recv_tensors(b)
+    # a fresh connection with the hook gone is clean
+    c, d = socket.socketpair()
+    try:
+        tensor_wire.send_tensors(c, {"op": "x"}, {"t": arr})
+        meta, tensors = tensor_wire.recv_tensors(d)
+        assert meta == {"op": "x"}
+        np.testing.assert_array_equal(tensors["t"], arr)
+    finally:
+        c.close()
+        d.close()
+
+
+def test_wire_stall_deadline_unwedges_mid_frame(sock_pair, monkeypatch):
+    monkeypatch.setenv("EDL_TPU_WIRE_STALL_S", "0.3")
+    a, b = sock_pair
+    body = json.dumps({"op": "ping"}).encode()
+    # half a frame, then silence: the reader must become a typed error,
+    # not a wedged thread
+    a.sendall(wire.MAGIC + len(body).to_bytes(4, "big") + body[:3])
+    with pytest.raises(wire.WireError, match="stalled mid-frame"):
+        wire.recv_msg(b)
+    # idle socket (no bytes at all) keeps its own timeout policy
+    b.settimeout(0.2)
+    with pytest.raises(TimeoutError):
+        wire.recv_msg(b)
+
+
+def test_tensor_wire_stall_deadline(sock_pair, monkeypatch):
+    monkeypatch.setenv("EDL_TPU_WIRE_STALL_S", "0.3")
+    a, b = sock_pair
+    a.sendall(tensor_wire.MAGIC + (64).to_bytes(4, "big") + b"{")
+    with pytest.raises(tensor_wire.TensorWireError,
+                       match="stalled mid-frame"):
+        tensor_wire.recv_tensors(b)
+
+
+# -- checkpoint integrity (ckpt_io + corruptor) -----------------------------
+
+def _seal(tmp_path, arrays: dict) -> str:
+    leaves, chunks = [], []
+    for i, name in enumerate(sorted(arrays)):
+        arr = arrays[name]
+        fname = ckpt_io.chunk_name(i, tuple(0 for _ in arr.shape))
+        chunks.append((fname, arr))
+        leaves.append({"key": name, "shape": list(arr.shape),
+                       "dtype": str(arr.dtype),
+                       "chunks": [{"offset": [0] * arr.ndim,
+                                   "shape": list(arr.shape),
+                                   "file": fname}]})
+    d = os.path.join(tmp_path, "ckpt-0")
+    ckpt_io.write_snapshot(d, {"leaves": leaves, "chunks": chunks,
+                               "process_index": 0})
+    return d
+
+
+def _read_all(d):
+    merged = ckpt_io.read_merged_index(d)
+    files = ckpt_io.ChunkFiles(d, crcs=ckpt_io.checksum_map(merged))
+    try:
+        return {k: np.array(ckpt_io.read_region(
+            files.load, e, tuple(slice(0, s) for s in e["shape"])))
+            for k, e in merged.items()}
+    finally:
+        files.close()
+
+
+def test_write_snapshot_records_crcs_and_roundtrips(tmp_path):
+    arrays = {"w": np.random.default_rng(0).standard_normal((8, 4)),
+              "step": np.int64(7).reshape(())}
+    d = _seal(str(tmp_path), arrays)
+    merged = ckpt_io.read_merged_index(d)
+    crcs = ckpt_io.checksum_map(merged)
+    assert len(crcs) == 2 and all(isinstance(v, int) for v in crcs.values())
+    out = _read_all(d)
+    np.testing.assert_array_equal(out["w"], arrays["w"])
+    assert out["step"] == 7
+
+
+def test_bitflip_below_npy_header_is_caught_by_crc_only(tmp_path):
+    arrays = {"w": np.ones((32, 8), np.float32)}
+    d = _seal(str(tmp_path), arrays)
+    rec = CheckpointCorruptor.corrupt(str(tmp_path), random.Random(0),
+                                      mode="bitflip")
+    assert rec is not None and rec["version"] == 0
+    path = os.path.join(d, rec["file"])
+    assert rec["offset"] >= _npy_data_offset(path)
+    # np.load itself is oblivious — the corruption is silent...
+    assert np.load(path).shape == (32, 8)
+    # ...and ONLY the crc catches it, as a typed error
+    with pytest.raises(EdlCheckpointCorrupt, match="integrity"):
+        _read_all(d)
+
+
+def test_truncated_chunk_is_typed_even_without_crcs(tmp_path):
+    arrays = {"w": np.ones((64, 8), np.float32)}
+    d = _seal(str(tmp_path), arrays)
+    rec = CheckpointCorruptor.corrupt(str(tmp_path), random.Random(0),
+                                      mode="truncate")
+    merged = ckpt_io.read_merged_index(d)
+    files = ckpt_io.ChunkFiles(d, crcs=None)  # no checksums at all
+    with pytest.raises(EdlCheckpointCorrupt):
+        files.load(rec["file"])
+    files.close()
+
+
+def test_verify_off_lets_bitflip_through(tmp_path, monkeypatch):
+    arrays = {"w": np.ones((32, 8), np.float32)}
+    d = _seal(str(tmp_path), arrays)
+    CheckpointCorruptor.corrupt(str(tmp_path), random.Random(0),
+                                mode="bitflip")
+    monkeypatch.setenv("EDL_TPU_CKPT_VERIFY", "0")
+    out = _read_all(d)  # no raise: garbage sails through...
+    assert not np.array_equal(out["w"], arrays["w"])  # ...demonstrably
+
+
+def test_manager_restore_falls_back_past_corrupt_version(tmp_path):
+    jax = pytest.importorskip("jax")
+    from edl_tpu.train.checkpoint import CheckpointManager
+    from edl_tpu.train.state import TrainStatus
+
+    state = {"w": jax.numpy.arange(128, dtype=jax.numpy.float32),
+             "b": jax.numpy.ones((4,), jax.numpy.float32)}
+    mgr = CheckpointManager(str(tmp_path), sharded=True, max_to_keep=4)
+    mgr.save(state, TrainStatus(epoch=0, step=10))
+    state2 = {"w": state["w"] + 1, "b": state["b"] + 1}
+    mgr.save(state2, TrainStatus(epoch=0, step=20))
+    rec = CheckpointCorruptor.corrupt(str(tmp_path), random.Random(1),
+                                      mode="bitflip")
+    assert rec["version"] == 1
+    target = {"w": np.zeros(128, np.float32), "b": np.zeros(4, np.float32)}
+    restored, status = mgr.restore(target)
+    # fell back to ckpt-0, loudly, instead of loading garbage
+    assert status.step == 10
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(128, dtype=np.float32))
+    # an EXPLICIT version surfaces the corruption to the caller
+    with pytest.raises(EdlCheckpointCorrupt):
+        mgr.restore(target, version=1)
+
+
+def test_snapshot_host_tree_manifests_carry_crcs():
+    pytest.importorskip("jax")
+    from edl_tpu.train import sharded_checkpoint as sc
+    snap = sc.snapshot_host_tree({"w": np.ones((4, 4), np.float32)})
+    crcs = [c.get("crc32") for leaf in snap["leaves"]
+            for c in leaf["chunks"]]
+    assert crcs and all(isinstance(c, int) for c in crcs)
+
+
+# -- worker checkpoint rig --------------------------------------------------
+
+def test_worker_rig_detects_quarantines_and_falls_back(tmp_path):
+    from edl_tpu.chaos.worker import CheckpointRig, Reporter
+    report_path = str(tmp_path / "report.jsonl")
+    rig = CheckpointRig(str(tmp_path / "ckpt"), slot=0,
+                        report=Reporter(report_path))
+    rig.seal()
+    rig.seal()
+    rec = CheckpointCorruptor.corrupt(str(tmp_path / "ckpt"),
+                                      random.Random(0), mode="bitflip")
+    assert rec["version"] == 1
+    rig.verify_all()
+    records = load_jsonl(report_path)
+    kinds = [r["kind"] for r in records]
+    assert "ckpt_corrupt_detected" in kinds
+    fb = next(r for r in records if r["kind"] == "ckpt_fallback")
+    assert fb["bad"] == 1 and fb["to"] == 0
+    assert rig.versions() == [0]  # quarantined out of the version set
+    assert os.path.isdir(tmp_path / "ckpt" / "corrupt-1")
+    # seal/restore digests agree for the surviving version
+    seals = {r["version"]: r["digest"] for r in records
+             if r["kind"] == "seal"}
+    rig.verify_all()
+    restores = [r for r in load_jsonl(report_path)
+                if r["kind"] == "restore"]
+    assert restores and all(r["digest"] == seals[r["version"]]
+                            for r in restores)
+
+
+# -- auditor ----------------------------------------------------------------
+
+def _auditor(**overrides):
+    base = dict(
+        injections=[{"t": 1.0, "fault": "wire", "target": "wire:all",
+                     "resolution": {"recovered": True}}],
+        worker_reports={}, probe={"acked": {}, "seen": {},
+                                  "duplicates": 0, "final_values": []},
+        scaler_journal=[], job_resize_log=[], pool_journal=[],
+        pool_resize_log=[], drain_log=[], drain_deadline_s=5.0)
+    base.update(overrides)
+    return InvariantAuditor(**base)
+
+
+def test_audit_clean_run_is_ok():
+    rep = _auditor().audit()
+    assert rep.ok and rep.stats["faults_survived"] == 1
+
+
+def test_audit_catches_lost_and_duplicate_marks():
+    rep = _auditor(probe={"acked": {"m1": 5, "m2": 6},
+                          "seen": {5: "m1"}, "duplicates": 2,
+                          "final_values": []}).audit()
+    assert any("duplicate" in b for b in rep.breaches)
+    assert any("m2" in b for b in rep.breaches)
+    # visible after resync = not lost
+    rep2 = _auditor(probe={"acked": {"m2": 6}, "seen": {},
+                           "duplicates": 0,
+                           "final_values": ["m2"]}).audit()
+    assert rep2.ok
+
+
+def test_audit_catches_journal_mismatch():
+    rep = _auditor(
+        scaler_journal=[{"action": "resize", "applied": 3}],
+        job_resize_log=[{"to": 2, "source": "resize"}]).audit()
+    assert any(b.startswith("I2") for b in rep.breaches)
+    # fault-injected resizes are injections, not scaler decisions
+    rep2 = _auditor(
+        scaler_journal=[{"action": "resize", "applied": 3}],
+        job_resize_log=[{"to": 4, "source": "fault"},
+                        {"to": 3, "source": "resize"}]).audit()
+    assert rep2.ok
+
+
+def test_audit_catches_silent_restore_divergence():
+    reports = {"pod0": [
+        {"kind": "seal", "version": 1, "digest": "aaa", "ts": 1},
+        {"kind": "restore", "version": 1, "digest": "bbb", "ts": 2},
+        {"kind": "restore", "version": 1, "digest": "bbb", "ts": 3}]}
+    rep = _auditor(worker_reports=reports).audit()
+    assert sum(1 for b in rep.breaches if b.startswith("I3")) == 1
+    # a DETECTED corruption is the contract working, not a breach
+    reports["pod0"].insert(1, {"kind": "ckpt_corrupt_detected",
+                               "version": 1, "ts": 1.5})
+    assert _auditor(worker_reports=reports).audit().ok
+
+
+def test_audit_catches_early_hard_kill_and_unresolved_fault():
+    rep = _auditor(
+        drain_log=[{"endpoint": "t0", "hard_killed": True,
+                    "wait_s": 1.0}],
+        injections=[{"t": 1.0, "fault": "process-kill",
+                     "target": "pod:0", "resolution": None}]).audit()
+    assert any(b.startswith("I4") for b in rep.breaches)
+    assert any(b.startswith("I5") for b in rep.breaches)
+    # a hard kill AT the deadline is the documented fallback
+    rep2 = _auditor(drain_log=[{"endpoint": "t0", "hard_killed": True,
+                                "wait_s": 5.0}]).audit()
+    assert rep2.ok
+
+
+def test_chaos_report_roundtrip():
+    rep = ChaosReport()
+    rep.breach("x")
+    doc = rep.to_dict()
+    assert doc["ok"] is False and doc["breaches"] == ["x"]

@@ -259,3 +259,63 @@ def test_shard_key_and_topology_parsing():
     assert list(parse_topology("x=a:1;y=b:1")) == ["x", "y"]
     chunked = parse_topology("a:1,b:1,c:1", shards=3)
     assert [len(v) for v in chunked.values()] == [1, 1, 1]
+
+
+def test_asymmetric_partition_writes_bounce_then_client_fails_over(group):
+    """The chaos-plane drill as a pinned contract (ISSUE 12): the old
+    leader stays REACHABLE by clients while cut off from quorum. Writes
+    through it must bounce with a refusal (never ack-then-lose), a
+    multi-endpoint client must fail over and keep writing, and a watch
+    resumed by revision must deliver every acked write."""
+    old = group.wait_leader()
+    # client whose dial order starts at the (about to be deposed) leader
+    ordered = [old.endpoint] + [e for e in group.endpoints
+                                if e != old.endpoint]
+    client = StoreClient(",".join(ordered), timeout=3.0,
+                         connect_retries=30, retry_interval=0.05)
+    acked: dict[str, int] = {}
+    for i in range(3):
+        acked[f"pre-{i}"] = client.put(f"/asym/{i}", f"pre-{i}")
+
+    old.node.set_partition(True)  # asymmetric: clients in, quorum out
+    # The write the partition catches first must REFUSE (commit gate
+    # timeout or not_leader once the lease ages out) — EdlStoreError,
+    # not a silent ack. put IS retryable-with-failover, so a refusal
+    # may also resolve into a successful re-route; both are correct,
+    # ack-then-lose is not.
+    t0 = time.monotonic()
+    survived = []
+    for i in range(3, 8):
+        try:
+            acked[f"post-{i}"] = client.put(f"/asym/{i}", f"post-{i}")
+            survived.append(i)
+        except EdlStoreError:
+            pass  # refusal: definitively not applied
+    assert survived, "client never failed over to the new leader"
+    assert time.monotonic() - t0 < 60.0
+    new = group.leader()
+    assert new is not None and new.endpoint != old.endpoint
+
+    # every ACKED write is delivered exactly once on a fresh watch
+    # resumed from before the partition (served by any live replica)
+    ha = StoreClient(",".join(e for e in group.endpoints
+                              if e != old.endpoint), timeout=3.0)
+    watch = ha.watch("/asym/", start_revision=0)
+    got: dict[int, str] = {}
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline \
+            and not set(acked.values()) <= set(got):
+        batch = watch.get(timeout=0.5)
+        if batch is None:
+            continue
+        for ev in batch.events:
+            assert ev.revision not in got, "duplicate delivery"
+            got[ev.revision] = ev.value
+    for value, rev in acked.items():
+        assert got.get(rev) == value, f"acked {value}@{rev} lost"
+    watch.cancel()
+    ha.close()
+
+    old.node.set_partition(None)  # heal: deposed leader snapshot-rejoins
+    assert _wait(lambda: old.node.role() == "follower", timeout=15.0)
+    client.close()

@@ -162,10 +162,15 @@ def test_incomplete_coverage_raises(tmp_path):
     state, _ = build_state(mesh)
     sc.save_sharded(str(tmp_path / "s"), state)
     # Delete one chunk file: restore must fail loudly, not zero-fill.
+    # Since the integrity plane (r18) a missing chunk is the TYPED
+    # EdlCheckpointCorrupt — what lets CheckpointManager.restore fall
+    # back to the previous sealed version instead of dying raw.
     import os
+
+    from edl_tpu.utils.exceptions import EdlCheckpointCorrupt
     chunks = [n for n in os.listdir(tmp_path / "s") if n.endswith(".npy")]
     biggest = max(chunks, key=lambda n: os.path.getsize(tmp_path / "s" / n))
     os.unlink(tmp_path / "s" / biggest)
     fresh, _ = build_state(mesh)
-    with pytest.raises((ValueError, FileNotFoundError)):
+    with pytest.raises((ValueError, EdlCheckpointCorrupt)):
         sc.restore_sharded(str(tmp_path / "s"), fresh)

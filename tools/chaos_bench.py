@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Chaos sweep: seeds x fault mixes -> a markdown robustness table.
+
+Each cell runs one ``python -m edl_tpu.chaos soak`` as a subprocess at
+a fixed (seed, mix) and reports what the invariant audit said: faults
+injected / survived, breaches (must be 0), worst observed recovery
+window, acked/delivered mark counts. The sweep is how a change to any
+elastic mechanism shows its robustness envelope — a regression appears
+as a nonzero breach column at some seed long before it costs a fleet.
+
+Sequential by design: the bench host has ONE core — never run cells
+concurrently (nor concurrent with tier-1).
+
+    python tools/chaos_bench.py --seeds 1,2,3 --ticks 16
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+
+# Named fault mixes: --mix restricts the schedule to a class subset so
+# a failure localizes (a breach under "store" implicates the
+# replication plane, not the checkpoint rig).
+MIXES = {
+    "all": None,
+    "store": ["wire", "store-partition", "leader-kill"],
+    "process": ["process-kill", "process-pause", "resize"],
+    "ckpt": ["ckpt-corrupt", "process-kill"],
+}
+
+
+def run_cell(seed: int, mix: str, ticks: int, settle_s: float) -> dict:
+    cmd = [sys.executable, "-m", "edl_tpu.chaos", "soak",
+           "--seed", str(seed), "--ticks", str(ticks),
+           "--settle-s", str(settle_s)]
+    if MIXES[mix]:
+        cmd += ["--mix", ",".join(MIXES[mix])]
+    proc = subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=600)
+    summary: dict = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("chaos_summary="):
+            summary = json.loads(line.split("=", 1)[1])
+    stats = summary.get("stats", {})
+    return {"seed": seed, "mix": mix, "rc": proc.returncode,
+            "injected": stats.get("faults_injected", 0),
+            "survived": stats.get("faults_survived", 0),
+            "breaches": len(summary.get("breaches", [])),
+            "classes": len(stats.get("fault_classes", [])),
+            "max_downtime_s": stats.get("max_downtime_s", 0.0),
+            "acked": stats.get("marks_acked", 0),
+            "sealed": stats.get("versions_sealed", 0)}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--seeds", default="1,2,3")
+    parser.add_argument("--mixes", default="all",
+                        help=f"comma-joined subset of {sorted(MIXES)}")
+    parser.add_argument("--ticks", type=int, default=16)
+    parser.add_argument("--settle-s", type=float, default=10.0)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+
+    seeds = [int(s) for s in args.seeds.split(",") if s]
+    mixes = [m for m in args.mixes.split(",") if m]
+    for m in mixes:
+        if m not in MIXES:
+            raise SystemExit(f"unknown mix {m!r} (have {sorted(MIXES)})")
+
+    rows = []
+    for mix in mixes:
+        for seed in seeds:
+            print(f"# soak seed={seed} mix={mix} ...", file=sys.stderr,
+                  flush=True)
+            rows.append(run_cell(seed, mix, args.ticks, args.settle_s))
+
+    if args.json:
+        print(json.dumps(rows, indent=1))
+        return 0
+    print("| seed | mix | faults | survived | breaches | classes "
+          "| max downtime s | marks acked | ckpts sealed |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['seed']} | {r['mix']} | {r['injected']} "
+              f"| {r['survived']} | {r['breaches']} | {r['classes']} "
+              f"| {r['max_downtime_s']} | {r['acked']} "
+              f"| {r['sealed']} |")
+    worst = max((r["breaches"] for r in rows), default=0)
+    print(f"\nworst breach count across {len(rows)} cells: {worst}")
+    return 1 if worst else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
