@@ -197,6 +197,7 @@ def bench_resnet(on_tpu: bool) -> dict:
 
     per_accel = imgs_per_sec / n_dev
     return {"imgs_per_sec": round(imgs_per_sec, 1),
+            "batch_size": batch_size,
             "pipeline_imgs_per_sec": round(pipe_imgs_per_sec, 1),
             "pipeline_loader_workers": mp_workers,
             "pipeline_packed_imgs_per_sec":
@@ -1583,6 +1584,88 @@ def bench_chaos(on_tpu: bool) -> dict:
     }
 
 
+def bench_obs(on_tpu: bool, step_s: float) -> dict:
+    """Observability-plane overhead (ISSUE 13 acceptance: the registry
+    must cost <1% of step time while live).
+
+    - obs_overhead_pct: wall cost of the per-step metric updates a fully
+      instrumented loop performs (counter.inc + gauge.set + histogram
+      .observe, measured over 20k iterations) as a percentage of the
+      MEASURED headline step time in this same artifact;
+    - metrics_scrape_ms: one Prometheus-text render of a realistically
+      populated registry (10 typed metrics + 8 stats-dict sources);
+    - resize_trace_spans: spans captured for one traced resize driven
+      through the REAL path (request_resize -> JobServer /resize ->
+      store-attached epoch publication) under EDL_TPU_TRACE.
+    Host-side plane: identical on every platform."""
+    del on_tpu
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import timeit as _timeit
+
+    from edl_tpu.obs import metrics as obs_metrics
+    from edl_tpu.obs import trace as obs_trace
+
+    reg = obs_metrics.Registry()
+    c = reg.counter("bench_rows", "rows served")
+    g = reg.gauge("bench_depth", "queue depth")
+    h = reg.histogram("bench_step_ms", obs_metrics.LOG_BUCKETS_MS)
+
+    def per_step():
+        c.inc(64)
+        g.set(3)
+        h.observe(7.3)
+
+    n = 20000
+    per_step_s = _timeit.timeit(per_step, number=n) / n
+    overhead_pct = 100.0 * per_step_s / max(step_s, 1e-9)
+
+    for i in range(8):
+        reg.register_stats(f"bench_src{i}", lambda: {
+            "served_rows": 123456, "queue_depth": 2, "util": 0.73,
+            "busy_s": 41.2, "inflight_groups": 1, "pending_hwm": 9,
+            "latency_hist_ms": {"5.0": 10, "10.0": 4, "inf": 1}})
+    for _ in range(3):
+        reg.render()  # warm
+    scrape_s = _timeit.timeit(reg.render, number=10) / 10
+
+    # one REAL traced resize: demo-shaped JobServer with a store
+    # attached, hit over HTTP under an active trace
+    from edl_tpu.collective.job_server import (JobServer, JobState,
+                                               request_resize)
+    from edl_tpu.coord.store import InMemStore
+    tmp = _tempfile.mkdtemp(prefix="edl-obs-bench-")
+    spans = 0
+    prev = os.environ.get("EDL_TPU_TRACE")
+    try:
+        os.environ["EDL_TPU_TRACE"] = tmp
+        obs_trace.reconfigure()
+        state = JobState("obs_bench", 1, 4, desired=2,
+                         store=InMemStore())
+        server = JobServer(state, port=0).start()
+        try:
+            request_resize(f"127.0.0.1:{server.port}", 3)
+        finally:
+            server.stop()
+        loaded = obs_trace.load_spans(tmp)
+        resizes = obs_trace.resize_phase_summary(loaded)
+        spans = resizes[0]["spans"] if resizes else 0
+    finally:
+        if prev is None:
+            os.environ.pop("EDL_TPU_TRACE", None)
+        else:
+            os.environ["EDL_TPU_TRACE"] = prev
+        obs_trace.reconfigure()
+        _shutil.rmtree(tmp, ignore_errors=True)
+
+    return {
+        "obs_overhead_pct": round(overhead_pct, 4),
+        "obs_metric_update_us": round(per_step_s * 1e6, 3),
+        "metrics_scrape_ms": round(scrape_s * 1e3, 3),
+        "resize_trace_spans": spans,
+    }
+
+
 def distill_quality_extras() -> dict:
     """Surface the flagship distill QUALITY measurement (the reference's
     acc1 77.1->79.0 story) from the newest committed artifact —
@@ -1626,6 +1709,10 @@ def main() -> None:
     control_plane = bench_control_plane(on_tpu)
     store_ha = bench_store_ha(on_tpu)
     chaos = bench_chaos(on_tpu)
+    # overhead is judged against THIS artifact's measured step time
+    headline_step_s = (resnet.get("batch_size", 256)
+                       / max(resnet["imgs_per_sec"], 1e-9))
+    obs = bench_obs(on_tpu, headline_step_s)
     cores_to_feed_jpeg = (resnet["imgs_per_sec"]
                           / max(loader["imgs_per_sec_per_core"], 1e-9))
     # the headline feed question, recomputed against the packed +
@@ -1776,6 +1863,11 @@ def main() -> None:
             # observed recovery window (tools/chaos_bench.py sweeps
             # seeds x fault mixes)
             **chaos,
+            # observability plane: per-step metric-update cost vs the
+            # measured headline step (<1% acceptance), scrape render
+            # time, spans per traced resize (tools/obs_bench.py has
+            # the on/off sweep)
+            **obs,
             # flagship distill QUALITY (committed artifact; see
             # tools/distill_quality_tpu.py)
             **distill_quality_extras(),

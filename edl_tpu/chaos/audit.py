@@ -75,7 +75,8 @@ class InvariantAuditor:
                  pool_journal: list[dict],
                  pool_resize_log: list[dict],
                  drain_log: list[dict],
-                 drain_deadline_s: float):
+                 drain_deadline_s: float,
+                 recorder: dict | None = None):
         self.injections = injections
         self.worker_reports = worker_reports
         self.probe = probe
@@ -85,6 +86,9 @@ class InvariantAuditor:
         self.pool_resize_log = pool_resize_log
         self.drain_log = drain_log
         self.drain_deadline_s = drain_deadline_s
+        # flight-recorder dump of the soak process (obs/recorder.py
+        # to_dict shape) — I2's third witness when provided
+        self.recorder = recorder
 
     # -- I1: the mark stream -----------------------------------------------
 
@@ -158,6 +162,32 @@ class InvariantAuditor:
             rep.breach(f"I2: pool journal {pool_asked} != actuator "
                        f"resize_log {pool_served}")
         rep.stats["pool_resizes"] = len(pool_served)
+        # Third witness (obs flight recorder): the serving path records
+        # one ring event per resize AS IT HAPPENS — independent of both
+        # the journal (scaler-side) and resize_log (server-side) lists.
+        # All three must tell the same story. Skipped when the ring
+        # overflowed (events aged out -> the comparison is void, and
+        # the stat says so) or no dump was provided.
+        if self.recorder is not None:
+            events = self.recorder.get("events", [])
+            if int(self.recorder.get("dropped", 0)) > 0:
+                rep.stats["recorder_witness"] = "overflowed"
+            else:
+                rec_job = [int(e["to"]) for e in events
+                           if e.get("kind") == "resize"
+                           and e.get("plane") == "job"
+                           and e.get("source") == "resize"]
+                if rec_job != served:
+                    rep.breach(f"I2: flight recorder saw job resizes "
+                               f"{rec_job} != resize_log {served}")
+                rec_pool = [int(e["to"]) for e in events
+                            if e.get("kind") == "resize"
+                            and e.get("plane") == "serving"]
+                if rec_pool != pool_served:
+                    rep.breach(f"I2: flight recorder saw pool resizes "
+                               f"{rec_pool} != actuator {pool_served}")
+                rep.stats["recorder_witness"] = "ok"
+                rep.stats["recorder_events"] = len(events)
 
     # -- I3: checkpoint bitwise equality ------------------------------------
 

@@ -50,6 +50,7 @@ from edl_tpu.collective.cluster import form_cluster
 from edl_tpu.collective.process import start_trainer, terminate_trainer
 from edl_tpu.coord.client import StoreClient
 from edl_tpu.coord.replication import ReplicaServer
+from edl_tpu.obs import recorder as flight
 from edl_tpu.utils.exceptions import EdlError, EdlStoreError
 from edl_tpu.utils.logging import get_logger
 from edl_tpu.utils.net import free_port
@@ -426,6 +427,11 @@ class SoakWorld:
                "duration": event.duration, "params": dict(event.params),
                "wall": round(time.time(), 3), "resolution": None}
         self.injections.append(rec)
+        # flight-recorder trail: every injection lands in the ring the
+        # crash dump / run-dir dump carries, beside the resize/election
+        # events the faults provoke
+        flight.record("chaos_fault", fault=event.fault,
+                      target=event.target, t=event.t)
         fault = event.fault
         try:
             if fault == "wire":
@@ -767,6 +773,8 @@ def run_soak(args) -> int:
     hang.start()
 
     world = SoakWorld(args)
+    # the run-dir dump + I2 third witness must cover THIS storm only
+    flight.recorder().clear()
     try:
         world.build()
         world.storm(schedule)
@@ -775,6 +783,16 @@ def run_soak(args) -> int:
         probe_doc = world.shutdown()
         if args.lockgraph:
             lock_report = graph.report()
+
+        # Flight-recorder collection: the soak process's own ring (the
+        # JobServer/actuator/replica events live here) lands in the run
+        # dir beside the workers' dumps (each worker wrote its own
+        # flight-<pod>.json on exit/crash) — and the auditor reads the
+        # ring's resize events as a third witness for I2.
+        recorder_dump = flight.recorder().to_dict(reason="soak-end")
+        with open(os.path.join(world.artifacts, "flight-soak.json"),
+                  "w") as f:
+            json.dump(recorder_dump, f, indent=1, default=str)
 
         auditor = InvariantAuditor(
             injections=world.injections,
@@ -785,7 +803,8 @@ def run_soak(args) -> int:
             pool_journal=world.pool_journal,
             pool_resize_log=list(world.actuator.resize_log),
             drain_log=list(world.actuator.drain_log),
-            drain_deadline_s=args.drain_deadline)
+            drain_deadline_s=args.drain_deadline,
+            recorder=recorder_dump)
         report = auditor.audit()
         if lock_report is not None and not lock_report["ok"]:
             report.breach(f"lockgraph: {len(lock_report['cycles'])} "

@@ -42,6 +42,7 @@ from edl_tpu.collective import register as reg
 from edl_tpu.collective.cluster import Cluster, Pod
 from edl_tpu.coord.client import StoreClient
 from edl_tpu.coord.collector import util_key
+from edl_tpu.obs import recorder as flight
 from edl_tpu.train import ckpt_io
 from edl_tpu.utils.backoff import Backoff
 from edl_tpu.utils.exceptions import EdlCheckpointCorrupt, EdlError
@@ -173,6 +174,9 @@ class CheckpointRig:
                 # pair detection with the injected corruption)
                 self.report("ckpt_corrupt_detected", version=version,
                             error=str(exc))
+                flight.record("corruption", plane="chaos-rig",
+                              slot=self.slot, version=version,
+                              error=str(exc))
                 vdir = os.path.join(self.directory, f"ckpt-{version}")
                 os.rename(vdir, os.path.join(self.directory,
                                              f"corrupt-{version}"))
@@ -193,6 +197,10 @@ def run_worker(args) -> int:
         stop["flag"] = True
 
     signal.signal(signal.SIGTERM, _term)
+    # flight-recorder wiring: a crashing worker dumps its ring next to
+    # its report (the soak collects both); SIGUSR2 dumps a live one
+    report_dir = os.path.dirname(os.path.abspath(args.report))
+    flight.install_dump_handlers(report_dir, tag=args.pod_id)
     report("started", pod_id=args.pod_id, slot=args.slot, pid=os.getpid(),
            verify=ckpt_io.verify_enabled())
 
@@ -291,6 +299,10 @@ def run_worker(args) -> int:
         report.close()
         watch_client.close()
         store.close()
+        # graceful-exit dump (SIGKILLed incarnations never reach here —
+        # their rings die with them, which is exactly what a crash ring
+        # models; the excepthook covers the crashing-but-alive case)
+        flight.dump_to(report_dir, tag=args.pod_id, reason="exit")
     return 0
 
 

@@ -26,6 +26,8 @@ import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from edl_tpu.obs import recorder as flight
+from edl_tpu.obs import trace
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.collective.job_server")
@@ -90,6 +92,14 @@ class JobState:
                                     "requested": desired,
                                     "clamped": clamped,
                                     "source": "resize"})
+            # flight-recorder witness: one event per SERVED resize, in
+            # log order — the chaos auditor cross-checks this ring
+            # against resize_log and the scaler journal (I2's third
+            # witness)
+            flight.record("resize", plane="job", job_id=self.job_id,
+                          frm=prev, to=self.desired, source="resize",
+                          epoch=self._migration_epoch
+                          + (1 if self.desired != prev else 0))
             if self.desired != prev:
                 self._publish_migration_epoch(prev)
             if clamped:
@@ -116,6 +126,10 @@ class JobState:
             self.resize_log.append({"from": prev, "to": self.desired,
                                     "requested": self.desired,
                                     "clamped": False, "source": "fault"})
+            flight.record("resize", plane="job", job_id=self.job_id,
+                          frm=prev, to=self.desired, source="fault",
+                          epoch=self._migration_epoch
+                          + (1 if self.desired != prev else 0))
             if self.desired != prev:
                 self._publish_migration_epoch(prev)
             log.info("fault injection: desired_nodes -> %d", self.desired)
@@ -171,7 +185,18 @@ def _make_handler(state: JobState):
                 self._reply({"error": f"'desired' must be an integer, "
                                       f"got {desired!r}"}, 400)
                 return
-            self._reply(state.resize(desired))
+            # Trace seam (HTTP hop): a caller's span context arrives in
+            # the X-EDL-Trace header; the served resize — including the
+            # epoch publication inside it, which embeds the context for
+            # the trainers — becomes a child span of the decision.
+            ctx = trace.parse_context(
+                (self.headers.get("X-EDL-Trace") or "").split(":")
+                if self.headers.get("X-EDL-Trace") else None)
+            with trace.adopt(ctx):
+                with trace.span("resize.actuate",
+                                attrs={"job": state.job_id,
+                                       "desired": desired}):
+                    self._reply(state.resize(desired))
 
         def log_message(self, fmt, *args):  # route into our logger
             log.debug("http: " + fmt, *args)
@@ -230,12 +255,20 @@ def request_resize(server: str, desired: int, timeout: float = 5.0) -> dict:
         server = "127.0.0.1" + server
     if not server.startswith("http"):
         server = "http://" + server
-    req = urllib.request.Request(
-        server + "/resize", method="POST",
-        data=json.dumps({"desired": desired}).encode(),
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        return json.loads(r.read())
+    # Trace root of a resize (unless the caller — e.g. the scaler's
+    # decide span — already has one): the context rides the HTTP hop as
+    # X-EDL-Trace, so actuation/adoption/restore all join this trace.
+    with trace.span("resize.request", attrs={"desired": desired}):
+        headers = {"Content-Type": "application/json"}
+        ctx = trace.inject()
+        if ctx is not None:
+            headers["X-EDL-Trace"] = ":".join(ctx)
+        req = urllib.request.Request(
+            server + "/resize", method="POST",
+            data=json.dumps({"desired": desired}).encode(),
+            headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
 
 
 class JobClient:

@@ -53,6 +53,8 @@ from typing import Any, Callable
 import numpy as np
 
 from edl_tpu.coord.store import Store
+from edl_tpu.obs import recorder as flight
+from edl_tpu.obs import trace
 from edl_tpu.train.ckpt_io import chunk_crc32, verify_enabled
 from edl_tpu.utils import config
 from edl_tpu.data.tensor_wire import (TensorWireError, recv_tensors,
@@ -166,7 +168,16 @@ class MigrationServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while not self._stop.is_set():
                 meta, _ = recv_tensors(conn)
-                self._handle(conn, meta)
+                # trace seam: a fetch sent under a restore span carries
+                # its context in the meta — the donor-side serve work
+                # shows up inside the SAME resize trace
+                ctx = trace.extract(meta)
+                if ctx is not None:
+                    with trace.span(f"migrate.serve_{meta.get('op')}",
+                                    parent=ctx):
+                        self._handle(conn, meta)
+                else:
+                    self._handle(conn, meta)
         except (TensorWireError, OSError):
             pass  # peer done / donor stopping
         finally:
@@ -262,6 +273,11 @@ class _PeerChunks:
         self._all_socks: list[socket.socket] = []
         self._socks_lock = threading.Lock()
         self.bytes_fetched = 0
+        # fetches run on restore_from_index's reader POOL threads —
+        # thread-local trace context does not cross, so the restore
+        # span is captured here and passed as each fetch's explicit
+        # parent (and rides the tensor-wire meta to the donor)
+        self._trace_parent = trace.current()
 
     def _sock_for(self, advert: dict) -> socket.socket:
         pool = getattr(self._local, "socks", None)
@@ -296,8 +312,13 @@ class _PeerChunks:
         if advert is None:
             raise PeerRestoreError(f"no donor owns chunk {fname}")
         sock = self._sock_for(advert)
-        send_tensors(sock, {"op": "fetch", "files": [fname]})
-        meta, tensors = recv_tensors(sock)
+        with trace.span("migrate.fetch", parent=self._trace_parent,
+                        attrs={"file": fname,
+                               "donor": advert.get("pod_id")}) as sp:
+            send_tensors(sock, {"op": "fetch", "files": [fname]})
+            meta, tensors = recv_tensors(sock)
+            if sp is not None and fname in tensors:
+                sp.attrs["bytes"] = int(tensors[fname].nbytes)
         if "error" in meta or fname not in tensors:
             raise PeerRestoreError(
                 f"donor {advert.get('pod_id')} failed serving {fname}: "
@@ -332,10 +353,49 @@ class _PeerChunks:
                 pass
 
 
+def resize_trace_ctx(store: Store, job_id: str) -> tuple[str, str] | None:
+    """The span context the last served resize embedded in its epoch
+    doc (publish_resize_epoch) — how a trainer that learns of a resize
+    asynchronously joins the decision's trace. None when tracing is
+    off, there is no epoch doc, or it carries no context."""
+    if not trace.enabled():
+        return None
+    try:
+        rec = store.get(epoch_key(job_id))
+        if rec is None:
+            return None
+        return trace.parse_context(json.loads(rec.value).get("trace"))
+    except Exception:  # noqa: BLE001 — observability only
+        return None
+
+
 def restore_from_peers(store: Store, job_id: str, target: Any, *,
                        local_version: int | None = None,
                        threads: int | None = None,
                        timeout: float = 5.0) -> tuple[Any, Any, dict]:
+    """Assemble ``target``'s state from live donor snapshots (traced:
+    the restore runs as a ``resize.restore_peers`` span parented onto
+    the resize that caused it, with per-chunk fetch child spans)."""
+    with trace.span("resize.restore_peers",
+                    parent=resize_trace_ctx(store, job_id),
+                    attrs={"job": job_id}) as sp:
+        state, status, stats = _restore_from_peers(
+            store, job_id, target, local_version=local_version,
+            threads=threads, timeout=timeout)
+        if sp is not None:
+            sp.attrs.update({k: stats[k] for k in
+                             ("version", "bytes_from_peers", "restore_s")})
+        flight.record("peer_restore", job_id=job_id,
+                      version=stats["version"],
+                      bytes_from_peers=stats["bytes_from_peers"],
+                      restore_s=stats["restore_s"])
+        return state, status, stats
+
+
+def _restore_from_peers(store: Store, job_id: str, target: Any, *,
+                        local_version: int | None = None,
+                        threads: int | None = None,
+                        timeout: float = 5.0) -> tuple[Any, Any, dict]:
     """Assemble ``target``'s state from live donor snapshots.
 
     Donor adverts are read from the store, the newest advertised version
@@ -797,10 +857,20 @@ def publish_resize_epoch(store: Store, job_id: str, *, epoch: int,
     """JobServer /resize hook: stamp a monotonic migration epoch with
     the donor roster alive at the decision instant — the fencing +
     audit record the demo and docs key on."""
-    roster = [{k: d.get(k) for k in ("pod_id", "addr", "port", "version",
-                                     "generation")}
-              for d in live_donors(store, job_id)]
-    doc = {"epoch": int(epoch), "ts": time.time(), "from": prev,
-           "desired": int(desired), "donors": roster}
-    store.put(epoch_key(job_id), json.dumps(doc, sort_keys=True))
-    return doc
+    with trace.span("resize.publish_epoch",
+                    attrs={"job": job_id, "epoch": int(epoch),
+                           "desired": int(desired)}):
+        roster = [{k: d.get(k) for k in ("pod_id", "addr", "port",
+                                         "version", "generation")}
+                  for d in live_donors(store, job_id)]
+        doc = {"epoch": int(epoch), "ts": time.time(), "from": prev,
+               "desired": int(desired), "donors": roster}
+        # Trace hop: the epoch doc carries the publication span's
+        # context, so trainers that adopt/restore off this resize join
+        # its trace even though they learn of it asynchronously
+        # through the store.
+        ctx = trace.inject()
+        if ctx is not None:
+            doc["trace"] = ctx
+        store.put(epoch_key(job_id), json.dumps(doc, sort_keys=True))
+        return doc

@@ -32,6 +32,7 @@ from collections import deque
 
 from edl_tpu.coord import wire
 from edl_tpu.coord.store import Event, Record, Store, Watch, WatchBatch
+from edl_tpu.obs import recorder as flight
 from edl_tpu.utils import config, exceptions
 from edl_tpu.utils.backoff import Backoff
 from edl_tpu.utils.exceptions import EdlStoreError
@@ -207,6 +208,11 @@ class StoreClient(Store):
                     # hang).
                     self._drop_sock()
                     blind_rounds += 1
+                    # flight-recorder trail: every client-visible
+                    # leadership bounce, with the hint that drove it
+                    flight.record("store_failover", op=req.get("op"),
+                                  hint=resp.get("leader"),
+                                  round=blind_rounds)
                     if blind_rounds > self._connect_retries:
                         raise EdlStoreError(
                             f"store rpc {req.get('op')}: no leader "

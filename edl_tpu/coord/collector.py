@@ -46,6 +46,7 @@ import time
 from typing import Any
 
 from edl_tpu.coord.store import Store
+from edl_tpu.obs import trace
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.coord.collector")
@@ -121,6 +122,14 @@ class UtilizationPublisher:
         self._pending = 0                # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # set by the TrainLoop at an adoption/peer restore: the resize
+        # trace's span context. The FIRST successful publish after it
+        # is the 'first fresh util at the new world' — the publisher
+        # emits a zero-duration marker span into that trace and clears
+        # it (one marker per resize; latest-wins slot, a benign
+        # single-attribute handoff between the training thread and the
+        # publisher thread).
+        self.resize_trace: tuple[str, str] | None = None
 
     @classmethod
     def from_env(cls) -> "UtilizationPublisher | None":
@@ -238,6 +247,15 @@ class UtilizationPublisher:
             self.store.put(util_key(self.job_id, self.pod_id),
                            json.dumps(doc, sort_keys=True),
                            lease=self._ensure_lease())
+            ctx, self.resize_trace = self.resize_trace, None
+            if ctx is not None:
+                # first utilization record published at the new world:
+                # the tail of the resize trace (decision -> actuation ->
+                # restore/adopt -> THIS)
+                trace.instant("resize.first_fresh_util", parent=ctx,
+                              attrs={"pod": self.pod_id,
+                                     "world": doc.get("world_size"),
+                                     "generation": doc.get("generation")})
         except Exception as exc:  # noqa: BLE001 — best-effort: a
             # publishing failure of ANY kind must never kill training
             log.warning("utilization publish failed (%s); pausing 30s", exc)

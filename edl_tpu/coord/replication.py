@@ -80,6 +80,8 @@ from edl_tpu.coord.client import StoreClient
 from edl_tpu.coord.consistent_hash import ConsistentHash
 from edl_tpu.coord.lock import DistributedLock
 from edl_tpu.coord.store import Event, InMemStore, Record, Store, Watch
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import recorder as flight
 from edl_tpu.utils import config
 from edl_tpu.utils.backoff import Backoff
 from edl_tpu.utils.exceptions import EdlStoreError
@@ -354,6 +356,12 @@ class ReplicaNode:
         self._partition: frozenset[str] | bool = False
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # election-churn counters (the obs registry's replica view; the
+        # flight recorder keeps the per-transition event detail)
+        self._elections_won = 0            # guarded-by: _state_lock
+        self._step_downs = 0               # guarded-by: _state_lock
+        self._snapshot_installs = 0        # guarded-by: _state_lock
+        self._obs = obs_metrics.register_stats("replica", self.stats)
         self.store.set_passive(True)
         self.quorum = QuorumLease(self)
 
@@ -372,9 +380,23 @@ class ReplicaNode:
             t.start()
         return self
 
+    def stats(self) -> dict:
+        """Replica counters as a dict view (registered into the obs
+        registry at construction): role/term plus election churn —
+        the numbers the HA bench and a scrape read identically."""
+        with self._state_lock:
+            return {"role": self._role, "term": self._term,
+                    "dirty": self._dirty,
+                    "is_leader": self._role == "leader",
+                    "elections_won": self._elections_won,
+                    "step_downs": self._step_downs,
+                    "snapshot_installs": self._snapshot_installs,
+                    "peers": len(self.peers)}
+
     def stop(self, graceful: bool = True) -> None:
         """Graceful stop resigns (successors campaign immediately);
         ``graceful=False`` simulates a crash — locks stay until TTL."""
+        obs_metrics.unregister(self._obs)
         self._stop.set()
         with self._wake_cond:
             self._wake_cond.notify_all()
@@ -554,6 +576,9 @@ class ReplicaNode:
             self._leader_endpoint = self.endpoint
             self._last_leader_contact = time.monotonic()
             self._dirty = False
+            self._elections_won += 1
+        flight.record("election", replica=self.endpoint, group=self.group,
+                      term=new_term, won=True)
         # active mode: resume lease-expiry duty; every lease clock
         # restarts at now+ttl (late expiry is safe, early is not)
         self.store.set_passive(False)
@@ -582,8 +607,12 @@ class ReplicaNode:
             if was_leader:
                 self._leader_endpoint = None
                 self._dirty = True
+                self._step_downs += 1
+            term = self._term
         if was_leader:
             self.store.set_passive(True)
+            flight.record("failover", replica=self.endpoint,
+                          group=self.group, term=term, reason=reason)
             log.warning("replica %s deposed (%s) — dirty until snapshot "
                         "rejoin", self.endpoint, reason)
         self.quorum.release()
@@ -844,6 +873,10 @@ class ReplicaNode:
         self.store.install_snapshot(req.get("state") or {})
         with self._state_lock:
             self._dirty = False
+            self._snapshot_installs += 1
+        flight.record("snapshot_install", replica=self.endpoint,
+                      group=self.group,
+                      revision=self.store.current_revision)
         log.info("replica %s installed snapshot at revision %d",
                  self.endpoint, self.store.current_revision)
         return {"ok": True, "revision": self.store.current_revision,

@@ -20,6 +20,7 @@ import threading
 
 from edl_tpu.coord import wire
 from edl_tpu.coord.store import InMemStore
+from edl_tpu.obs import metrics, trace
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.coord.server")
@@ -36,6 +37,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 req = wire.recv_msg(sock)
             except (wire.WireError, OSError):
                 return
+            # Trace seam: a request sent under an active span carries
+            # its context ("_tc", popped here so replication forwarding
+            # never re-ships it); the op then executes as a child span
+            # of the caller's — the store hop of a resize trace.
+            ctx = trace.extract(req)
             resp = None
             if node is not None:
                 # The replica node owns routing: shard REDIRECTs,
@@ -55,7 +61,12 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             if resp is None:
                 try:
-                    resp = self._dispatch(store, req)
+                    if ctx is not None:
+                        with trace.span(f"store.{req.get('op')}",
+                                        parent=ctx):
+                            resp = self._dispatch(store, req)
+                    else:
+                        resp = self._dispatch(store, req)
                 except Exception as exc:  # surface the error to the client
                     resp = {"ok": False,
                             "error": f"{type(exc).__name__}: {exc}"}
@@ -186,6 +197,9 @@ class StoreServer:
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         self._sweep_interval = sweep_interval
+        # the dict API stays the engine's contract; the registry is the
+        # scrape view over it (unregistered on stop)
+        self._obs = metrics.register_stats("store", self.store.stats)
 
     def start(self) -> "StoreServer":
         t = threading.Thread(target=self._server.serve_forever,
@@ -216,6 +230,7 @@ class StoreServer:
             watch.cancel()
         self._server.shutdown()
         self._server.server_close()
+        metrics.unregister(self._obs)
 
     def __enter__(self):
         return self.start()

@@ -295,6 +295,10 @@ class InMemStore(Store):
         # a follower that also expired locally would double-delete with
         # revisions the leader never assigned.
         self._passive = False                 # guarded-by: _lock
+        # watch fan-out accounting: event pushes delivered to watcher
+        # queues (the obs registry's view of the push plane)
+        self._fanout_events = 0               # guarded-by: _lock
+        self._expired_leases = 0              # guarded-by: _lock
 
     # -- internals ---------------------------------------------------------
 
@@ -311,12 +315,14 @@ class InMemStore(Store):
         for watcher in self._watchers:
             if ev.key.startswith(watcher.prefix):
                 watcher._push(ev)
+                self._fanout_events += 1
 
     def _expire(self) -> None:  # holds-lock: _lock
         if self._passive:
             return
         now = self._clock()
         dead = [l for l in self._leases.values() if l.deadline <= now]
+        self._expired_leases += len(dead)
         for lease in dead:
             for key in sorted(lease.keys):
                 rec = self._data.pop(key, None)
@@ -501,6 +507,21 @@ class InMemStore(Store):
     def watcher_count(self) -> int:
         with self._lock:
             return len(self._watchers)
+
+    def stats(self) -> dict:
+        """Engine counters as a dict view — what StoreServer registers
+        into the per-process obs registry (doc/design_obs.md): request
+        volume, watch fan-out, lease churn, history pressure."""
+        with self._lock:
+            return {"keys": len(self._data),
+                    "revision": self._revision,
+                    "ops": self.op_count,
+                    "leases_live": len(self._leases),
+                    "leases_expired": self._expired_leases,
+                    "watchers": len(self._watchers),
+                    "watch_fanout_events": self._fanout_events,
+                    "events_buffered": len(self._events),
+                    "passive": self._passive}
 
     # -- replication raw-apply (coord/replication.py) ------------------------
     #

@@ -57,6 +57,7 @@ import socket
 import struct
 from typing import Any
 
+from edl_tpu.obs import trace
 from edl_tpu.utils import config
 
 MAGIC = b"EDL1"
@@ -97,6 +98,13 @@ def stall_timeout() -> float:
 
 
 def send_msg(sock: socket.socket, msg: dict[str, Any]) -> None:
+    if "op" in msg:
+        # Trace seam (edl_tpu/obs/trace.py): requests carry the active
+        # span context under the reserved "_tc" key (copy-on-attach, a
+        # no-op when tracing is off), so server-side work joins the
+        # caller's trace — one resize reads as ONE causal tree across
+        # the store hop. Responses/pushes are never stamped.
+        msg = trace.attach(msg)
     body = json.dumps(msg, separators=(",", ":")).encode("utf-8")
     hook = _fault_hook
     if hook is not None:

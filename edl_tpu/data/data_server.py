@@ -42,6 +42,7 @@ import numpy as np
 
 from edl_tpu.data.tensor_wire import (TensorWireError, recv_tensors,
                                          send_tensors)
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils.exceptions import EdlDataError
 from edl_tpu.utils.logging import get_logger
 
@@ -60,6 +61,19 @@ class DataServer:
         self._accept_thread: threading.Thread | None = None
         self._conns: set[socket.socket] = set()  # guarded-by: _conns_lock
         self._conns_lock = threading.Lock()
+        # serving counters (mutated under _conns_lock; the obs registry
+        # reads the dict view at scrape time)
+        self._requests = 0               # guarded-by: _conns_lock
+        self._rows_served = 0            # guarded-by: _conns_lock
+        self._obs = obs_metrics.register_stats("data_server", self.stats)
+
+    def stats(self) -> dict:
+        """Serving counters as a dict view (obs registry source)."""
+        with self._conns_lock:
+            return {"connections": len(self._conns),
+                    "requests": self._requests,
+                    "rows_served": self._rows_served,
+                    "records": len(self.source)}
 
     def start(self) -> "DataServer":
         self._accept_thread = threading.Thread(
@@ -92,6 +106,7 @@ class DataServer:
                 c.close()
             except OSError:
                 pass
+        obs_metrics.unregister(self._obs)
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -136,6 +151,12 @@ class DataServer:
     def _handle(self, conn, meta: dict[str, Any],
                 tensors: dict[str, np.ndarray]) -> None:
         op = meta.get("op")
+        with self._conns_lock:
+            self._requests += 1
+            if op == "batch":
+                idx_t = tensors.get("idx")
+                self._rows_served += (int(np.asarray(idx_t).size)
+                                      if idx_t is not None else 0)
         if op == "ping":
             send_tensors(conn, {"ok": True})
         elif op == "len":

@@ -53,6 +53,7 @@ import multiprocessing as mp
 import numpy as np
 
 from edl_tpu.data import shm_ring
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils import config
 from edl_tpu.utils.exceptions import EdlDataError
 from edl_tpu.utils.logging import get_logger
@@ -180,6 +181,22 @@ class MpLoaderPool:
             range(n_slots))
         self.closed = False
         self.broken = False  # wedged drain: next epoch rebuilds the pool
+        # input-plane accounting (consumer-thread-only counters; the
+        # obs registry reads them as a scrape-time view)
+        self.batches_served = 0
+        self.redispatches = 0
+        self.spills = 0
+        self._obs = obs_metrics.register_stats("mp_loader", self.stats)
+
+    def stats(self) -> dict:
+        """Pool counters as a dict view (obs registry source)."""
+        return {"workers": len(self._procs),
+                "workers_alive": len(self._alive),
+                "batches_served": self.batches_served,
+                "redispatches": self.redispatches,
+                "slot_spills": self.spills,
+                "slots_free": len(self._free),
+                "broken": self.broken}
 
     # -- liveness ----------------------------------------------------------
 
@@ -215,6 +232,7 @@ class MpLoaderPool:
                     f"in-flight batch {step}")
             pend.attempt += 1
             pend.wid = self._least_loaded(outstanding)
+            self.redispatches += 1
             outstanding[step] = pend.wid
             step_, idx, sseeds, bseed = pend.desc
             self._task_qs[pend.wid].put(
@@ -266,8 +284,10 @@ class MpLoaderPool:
                         self._free.append(slot)
                         raise EdlDataError(
                             f"loader worker failed on batch {head}:\n{err}")
+                    self.batches_served += 1
                     if meta is None:
                         self._free.append(slot)  # spilled over the queue
+                        self.spills += 1
                         yield spill
                     else:
                         prev_slot = slot
@@ -361,6 +381,7 @@ class MpLoaderPool:
             # don't let a queue feeder thread block interpreter exit
             q.cancel_join_thread()
         self.ring.close()
+        obs_metrics.unregister(self._obs)
 
 
 def default_num_workers() -> int:

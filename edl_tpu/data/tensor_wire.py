@@ -28,6 +28,7 @@ from typing import Any
 
 import numpy as np
 
+from edl_tpu.obs import trace
 from edl_tpu.utils import config
 
 MAGIC = b"EDT1"
@@ -128,6 +129,11 @@ def _send_gather(sock: socket.socket, bufs: list) -> None:
 def send_tensors(sock: socket.socket, meta: dict[str, Any],
                  tensors: dict[str, np.ndarray] | None = None) -> None:
     tensors = tensors or {}
+    # Trace seam, mirroring coord/wire.py: the active span context
+    # rides the JSON header's meta under the reserved "_tc" key
+    # (copy-on-attach; no-op when tracing is off), so a donor serving
+    # chunks joins the restoring pod's resize trace.
+    meta = trace.attach(meta)
     descs, payloads = [], []
     for name, arr in tensors.items():
         # numpy-native dtypes only: senders downcast/upcast extension dtypes

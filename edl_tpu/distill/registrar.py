@@ -103,6 +103,7 @@ class TeacherRegistrar:
     def _utilization_info(self, cur: dict, prev: dict | None,
                           dt: float) -> str:
         from edl_tpu.distill.teacher_server import latency_quantile
+        from edl_tpu.obs.metrics import Histogram
         d_rows = cur["served_rows"] - (prev or {}).get("served_rows", 0)
         d_busy = cur["busy_s"] - (prev or {}).get("busy_s", 0.0)
         # coalescing effectiveness over THIS window (mean device-batch
@@ -115,9 +116,10 @@ class TeacherRegistrar:
         # teacher going slow shows up within one stats interval instead
         # of being averaged away by its fast past. The SLO signal the
         # serving scaler consumes; null when the window served nothing.
-        prev_lat = (prev or {}).get("latency_hist_ms", {})
-        d_lat = {k: int(v) - int(prev_lat.get(k, 0))
-                 for k, v in cur.get("latency_hist_ms", {}).items()}
+        # The differencing is the shared obs Histogram primitive — the
+        # same windowed-vs-cumulative contract the regression tests pin.
+        d_lat = Histogram.window(cur.get("latency_hist_ms", {}),
+                                 (prev or {}).get("latency_hist_ms", {}))
         return json.dumps({
             "rows_per_sec": round(d_rows / max(dt, 1e-9), 1),
             "util": round(min(1.0, d_busy / max(dt, 1e-9)), 3),

@@ -54,6 +54,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from edl_tpu.data import tensor_wire
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.distill.teacher_server")
@@ -64,28 +65,19 @@ DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 # Fixed-bucket per-request latency histogram edges (ms, upper bounds;
 # final bucket is open-ended). Fixed buckets — not a reservoir — so the
 # registrar can difference two cumulative snapshots into an exact
-# windowed histogram and quantiles never drift under load.
-LATENCY_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
-                      500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+# windowed histogram and quantiles never drift under load. The pattern
+# generalized into the shared obs Histogram type (obs/metrics.py);
+# these edges are the obs plane's canonical log ladder.
+LATENCY_BUCKETS_MS = obs_metrics.LOG_BUCKETS_MS
 
 
 def latency_quantile(hist_ms: dict, q: float) -> float | None:
     """q-quantile of a ``{bucket_upper_ms: count}`` histogram (keys may
     be str off the wire). Answers with the bucket's UPPER edge —
     conservative: the reported p95 is never below the true one, so an
-    SLO decision made on it never under-provisions. None when empty."""
-    items = sorted(((float(k), int(v)) for k, v in hist_ms.items()),
-                   key=lambda kv: kv[0])
-    total = sum(c for _, c in items)
-    if total <= 0:
-        return None
-    target = q * total
-    cum = 0
-    for edge, count in items:
-        cum += count
-        if cum >= target:
-            return edge
-    return items[-1][0]
+    SLO decision made on it never under-provisions. None when empty.
+    (Shim over the shared obs Histogram quantile.)"""
+    return obs_metrics.Histogram.quantile(hist_ms, q)
 
 
 def pad_to_bucket(n: int, buckets: tuple[int, ...]) -> int:
@@ -189,8 +181,12 @@ class Batcher:
         # observable instead of inferred.
         self._batch_hist: dict[int, int] = {}  # guarded-by: _stats_lock
         # Per-request latency histogram (fixed buckets, cumulative):
-        # the SLO signal the serving scaler consumes. inf = overflow.
-        self._lat_hist: dict[float, int] = {}  # guarded-by: _stats_lock
+        # the SLO signal the serving scaler consumes. The shared obs
+        # Histogram type (its own leaf lock; _stats_lock still orders
+        # it against the sibling counters so one stats() snapshot is
+        # coherent). inf = overflow.
+        self._lat_hist = obs_metrics.Histogram(
+            LATENCY_BUCKETS_MS)         # guarded-by: _stats_lock
 
     def start(self) -> "Batcher":
         for t in self._threads:
@@ -331,10 +327,7 @@ class Batcher:
                 self._served_requests += len(group)
                 self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
                 for req in group:
-                    ms = (now - req.t_submit) * 1e3
-                    edge = next((b for b in LATENCY_BUCKETS_MS
-                                 if ms <= b), float("inf"))
-                    self._lat_hist[edge] = self._lat_hist.get(edge, 0) + 1
+                    self._lat_hist.observe((now - req.t_submit) * 1e3)
                 self._groups_inflight -= 1
             offset = 0
             for req in group:
@@ -350,7 +343,7 @@ class Batcher:
             groups = sum(hist.values())
             rows_mean = (sum(r * c for r, c in hist.items()) / groups
                          if groups else 0.0)
-            lat = dict(sorted(self._lat_hist.items()))
+            lat = self._lat_hist.snapshot()  # ascending edges, inf last
             return {"served_rows": self._served_rows,
                     "served_requests": self._served_requests,
                     "busy_s": round(self._busy_s, 4),
@@ -604,6 +597,10 @@ class TeacherServer:
         self._server.conns_lock = threading.Lock()  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
         self._started = False
+        # the Batcher's stats() dict stays the registrar's API; the
+        # per-process obs registry serves the same numbers as gauges
+        self._obs = obs_metrics.register_stats("teacher",
+                                               self.batcher.stats)
 
     def start(self) -> "TeacherServer":
         if self._started:
@@ -635,6 +632,7 @@ class TeacherServer:
             except OSError:
                 pass
         self.batcher.stop()
+        obs_metrics.unregister(self._obs)
 
     def __enter__(self):
         return self.start()

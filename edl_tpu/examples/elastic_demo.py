@@ -546,6 +546,21 @@ def run_p2p_demo(args) -> int:
         "peer_restore_s": [d.get("restore_s") for d in peer_restores],
         "migration_epochs_published": state._migration_epoch,
         "served_resizes": state.resize_log}
+    from edl_tpu.obs import trace as obs_trace
+    if obs_trace.enabled():
+        # the traced-resize acceptance surface: one causally-linked
+        # trace per resize, phases summing against the measured
+        # downtime — viewable via `python -m edl_tpu.obs trace <dir>`.
+        # Only traces started by THIS run count (the sink dir persists
+        # across runs by design).
+        spans = obs_trace.load_spans(obs_trace.sink_dir())
+        resizes = [r for r in obs_trace.resize_phase_summary(spans)
+                   if t_shrink is None or r["t0"] >= t_shrink - 60.0]
+        summary["trace_dir"] = obs_trace.sink_dir()
+        summary["resize_traces"] = [
+            {"trace_id": r["trace_id"], "spans": r["spans"],
+             "phases": r["phases"], "downtime_s": r["downtime_s"]}
+            for r in resizes]
     log.info("p2p demo done: %s", summary)
     if not ok:
         log.error("p2p audit failed: the resize path fell back to the "

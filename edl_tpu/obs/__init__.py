@@ -1,0 +1,26 @@
+"""edl_tpu.obs — the unified observability plane.
+
+Three legs, one import surface (doc/design_obs.md):
+
+- :mod:`edl_tpu.obs.metrics` — typed Counter/Gauge/Histogram (fixed
+  log buckets: snapshots difference exactly), the per-process
+  registry every ``stats()`` dict registers into, the Prometheus-text
+  scrape endpoint (``EDL_TPU_METRICS_PORT``) and the store-published
+  JSON snapshot;
+- :mod:`edl_tpu.obs.trace` — causal spans with context propagated
+  in-band through both wire planes (``EDL_TPU_TRACE``), merged and
+  exported by ``python -m edl_tpu.obs trace``;
+- :mod:`edl_tpu.obs.recorder` — the always-on bounded flight recorder
+  ring (``EDL_TPU_FLIGHT_RECORDER_N``), dumped on crash/SIGUSR2 and
+  consumed by the chaos InvariantAuditor.
+
+Pure stdlib and jax/numpy-free by contract: the scrape/trace/recorder
+plane must run on a scheduler node, a bare CI runner, and inside every
+trainer alike. The layering row in analysis/layers.toml makes the
+contract a CI gate; ``python -m edl_tpu.obs selftest`` asserts it at
+runtime.
+"""
+
+from edl_tpu.obs import metrics, recorder, trace
+
+__all__ = ["metrics", "recorder", "trace"]

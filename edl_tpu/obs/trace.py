@@ -1,0 +1,411 @@
+"""Causal spans: Dapper-style trace propagation across the wire seams.
+
+A *span* is a named, timed operation; spans carry ``(trace_id,
+span_id)`` and parent onto whatever context is current on their thread
+— or onto an explicit remote context extracted from a wire frame. Both
+wire planes propagate context in-band: ``coord/wire.py`` attaches a
+``"_tc"`` key to request frames, ``data/tensor_wire.py`` attaches it to
+the JSON header's ``meta``. One resize therefore becomes ONE causally
+linked tree across every process it touches:
+
+    resize.request (scaler/demo)                     <- root
+      resize.actuate (JobServer /resize)             <- HTTP header hop
+        store.put (epoch publication)                <- coord wire hop
+        resize.adopt (surviving trainer)             <- epoch-doc hop
+          resize.first_fresh_util                    <- util publisher
+        resize.restore_peers (grown pod)
+          migrate.fetch x chunks                     <- tensor wire hop
+            migrate.serve_fetch (donor process)
+
+Enablement: ``EDL_TPU_TRACE`` — unset/0 = off (spans are a single
+attribute read + ``if`` on the hot path), ``1`` = on with the default
+sink directory ``./edl_trace``, any other value = on with that value as
+the sink directory. Every process appends finished spans to its own
+``spans-<pid>.jsonl`` in the sink dir (timestamps are wall-clock so
+files from different processes merge); a bounded in-process ring keeps
+the most recent spans readable without file I/O (tests, resize_bench's
+phase column). ``python -m edl_tpu.obs trace <dir>`` merges the files
+into per-trace trees and exports Chrome-trace/Perfetto JSON.
+
+Pure stdlib, jax/numpy-free (layers.toml obs row).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any
+
+from edl_tpu.utils import config
+
+DEFAULT_DIR = "edl_trace"
+RING_CAP = 4096
+
+_tls = threading.local()
+_lock = threading.Lock()
+_ring: collections.deque = collections.deque(maxlen=RING_CAP)
+_file = None          # guarded-by: _lock
+_file_pid = None      # guarded-by: _lock (fork detection)
+_cached: tuple[bool, str | None] | None = None
+
+
+def _setting() -> tuple[bool, str | None]:
+    """(enabled, sink_dir) — parsed once per process; tests reset via
+    `reconfigure()`."""
+    global _cached
+    if _cached is None:
+        raw = (config.env_str("EDL_TPU_TRACE") or "").strip()
+        if not raw or raw.lower() in ("0", "false", "no", "off"):
+            _cached = (False, None)
+        elif raw.lower() in ("1", "true", "yes", "on"):
+            _cached = (True, DEFAULT_DIR)
+        else:
+            _cached = (True, raw)
+    return _cached
+
+
+def reconfigure() -> None:
+    """Re-read EDL_TPU_TRACE and drop the sink file handle + ring
+    (tests flip the env mid-process; real processes never need this)."""
+    global _cached, _file, _file_pid
+    with _lock:
+        _cached = None
+        if _file is not None:
+            try:
+                _file.close()
+            except OSError:
+                pass
+        _file = None
+        _file_pid = None
+        _ring.clear()
+
+
+def enabled() -> bool:
+    return _setting()[0]
+
+
+def sink_dir() -> str | None:
+    return _setting()[1]
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+def current() -> tuple[str, str] | None:
+    """The active ``(trace_id, span_id)`` on this thread, or None."""
+    return getattr(_tls, "ctx", None)
+
+
+def _emit(record: dict) -> None:
+    global _file, _file_pid
+    _ring.append(record)
+    directory = sink_dir()
+    if directory is None:
+        return
+    line = json.dumps(record, separators=(",", ":"), default=str)
+    with _lock:
+        if _file is None or _file_pid != os.getpid():
+            # per-process file: concurrent writers never interleave, and
+            # a fork (mp loader workers) gets its own file not a shared fd
+            try:
+                os.makedirs(directory, exist_ok=True)
+                _file = open(os.path.join(
+                    directory, f"spans-{os.getpid()}.jsonl"), "a")
+                _file_pid = os.getpid()
+            except OSError:
+                return
+        try:
+            _file.write(line + "\n")
+            _file.flush()   # pods die by signal mid-demo: don't buffer
+        except (OSError, ValueError):
+            pass
+
+
+class Span:
+    """A started span; ``end()`` stamps the duration and emits it.
+    Returned by :func:`start_span` for operations that end on another
+    thread or at a later callback (the in-place adoption gap)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "t0",
+                 "attrs", "_done")
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: str | None, attrs: dict | None):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = time.time()
+        self.attrs = dict(attrs or {})
+        self._done = False
+
+    @property
+    def context(self) -> tuple[str, str]:
+        return (self.trace_id, self.span_id)
+
+    def end(self, **attrs: Any) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.attrs.update(attrs)
+        _emit({"tid": self.trace_id, "sid": self.span_id,
+               "parent": self.parent_id, "name": self.name,
+               "pid": os.getpid(), "t0": round(self.t0, 6),
+               "dur": round(time.time() - self.t0, 6),
+               "attrs": self.attrs})
+
+
+def start_span(name: str, parent: tuple[str, str] | None = None,
+               attrs: dict | None = None) -> Span | None:
+    """Begin a span (None when tracing is off). Does NOT alter the
+    thread's current context — use :func:`span` for scoped work."""
+    if not enabled():
+        return None
+    ctx = parent if parent is not None else current()
+    if ctx is not None:
+        trace_id, parent_id = ctx
+    else:
+        trace_id, parent_id = _new_id(), None
+    return Span(name, trace_id, _new_id(), parent_id, attrs)
+
+
+@contextlib.contextmanager
+def span(name: str, parent: tuple[str, str] | None = None,
+         attrs: dict | None = None):
+    """Scoped span: children started inside the body (this thread, or
+    remote via the wire seams) parent onto it. Yields the Span (None
+    when tracing is off) so callers can add attrs."""
+    if not enabled():
+        yield None
+        return
+    s = start_span(name, parent=parent, attrs=attrs)
+    prev = current()
+    _tls.ctx = s.context
+    try:
+        yield s
+    finally:
+        _tls.ctx = prev
+        s.end()
+
+
+def instant(name: str, parent: tuple[str, str] | None = None,
+            attrs: dict | None = None) -> None:
+    """Zero-duration marker span (the 'first fresh util' tick)."""
+    s = start_span(name, parent=parent, attrs=attrs)
+    if s is not None:
+        s.end()
+
+
+def event(name: str, dur_s: float,
+          parent: tuple[str, str] | None = None,
+          attrs: dict | None = None) -> None:
+    """Emit a pre-measured finished span (the utils/timeline shim's
+    path: the operation already happened, only its duration is known).
+    Parents onto the current/explicit context like any other span."""
+    if not enabled():
+        return
+    ctx = parent if parent is not None else current()
+    if ctx is not None:
+        trace_id, parent_id = ctx
+    else:
+        trace_id, parent_id = _new_id(), None
+    now = time.time()
+    _emit({"tid": trace_id, "sid": _new_id(), "parent": parent_id,
+           "name": name, "pid": os.getpid(),
+           "t0": round(now - dur_s, 6), "dur": round(dur_s, 6),
+           "attrs": dict(attrs or {})})
+
+
+@contextlib.contextmanager
+def adopt(ctx):
+    """Make a remote context current for the body (no span of its own):
+    spans opened inside parent onto the remote span. ``ctx`` may be
+    None or malformed (straight off a wire frame) — then it's a no-op."""
+    ctx = parse_context(ctx)
+    if ctx is None or not enabled():
+        yield
+        return
+    prev = current()
+    _tls.ctx = ctx
+    try:
+        yield
+    finally:
+        _tls.ctx = prev
+
+
+def parse_context(raw) -> tuple[str, str] | None:
+    """Validate a wire-shaped context (list/tuple of two id strings) —
+    garbled frames yield None, never an exception."""
+    if (isinstance(raw, (list, tuple)) and len(raw) == 2
+            and all(isinstance(x, str) and 0 < len(x) <= 64 for x in raw)):
+        return (raw[0], raw[1])
+    return None
+
+
+def inject() -> list[str] | None:
+    """The current context in wire shape (``["tid", "sid"]``), or None
+    when tracing is off / no span is active."""
+    ctx = current() if enabled() else None
+    return [ctx[0], ctx[1]] if ctx is not None else None
+
+
+def attach(d: dict) -> dict:
+    """Copy-on-write attach of the current context to a wire dict under
+    the reserved ``"_tc"`` key (both wire planes call this on their
+    send path). Returns ``d`` untouched when there is nothing to add."""
+    ctx = inject()
+    if ctx is None or "_tc" in d:
+        return d
+    out = dict(d)
+    out["_tc"] = ctx
+    return out
+
+
+def extract(d: dict) -> tuple[str, str] | None:
+    """Pop the propagated context off a received wire dict (request
+    msg or tensor-frame meta); tolerant of absence and garbling."""
+    if not isinstance(d, dict):
+        return None
+    return parse_context(d.pop("_tc", None))
+
+
+def finished(prefix: str | None = None) -> list[dict]:
+    """Snapshot of the in-process ring of finished spans (newest last),
+    optionally filtered by name prefix."""
+    spans = list(_ring)
+    if prefix is not None:
+        spans = [s for s in spans if s["name"].startswith(prefix)]
+    return spans
+
+
+def clear_ring() -> None:
+    _ring.clear()
+
+
+# -- merged-trace analysis (CLI `python -m edl_tpu.obs trace`, the
+#    resize_bench phase column, and bench_obs all read through these) --
+
+def load_spans(directory: str) -> list[dict]:
+    """Every span from every ``spans-*.jsonl`` in ``directory``
+    (garbled lines skipped — a killed pod can tear its last write)."""
+    out: list[dict] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for fname in names:
+        if not (fname.startswith("spans-") and fname.endswith(".jsonl")):
+            continue
+        try:
+            with open(os.path.join(directory, fname)) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and "tid" in rec:
+                        out.append(rec)
+        except OSError:
+            continue
+    return out
+
+
+def group_traces(spans: list[dict]) -> dict[str, list[dict]]:
+    """trace_id -> spans sorted by start time."""
+    traces: dict[str, list[dict]] = {}
+    for s in spans:
+        traces.setdefault(s["tid"], []).append(s)
+    for tid in traces:
+        traces[tid].sort(key=lambda s: s.get("t0", 0.0))
+    return traces
+
+
+def span_tree(spans: list[dict]) -> list[tuple[dict, int]]:
+    """Depth-first (span, depth) ordering of one trace's spans.
+    Orphans (parent span lost — a killed process) surface at depth 0
+    rather than disappearing."""
+    by_id = {s["sid"]: s for s in spans}
+    children: dict[str | None, list[dict]] = {}
+    for s in spans:
+        parent = s.get("parent")
+        if parent is not None and parent not in by_id:
+            parent = None
+        children.setdefault(parent, []).append(s)
+    for v in children.values():
+        v.sort(key=lambda s: s.get("t0", 0.0))
+    out: list[tuple[dict, int]] = []
+
+    def walk(parent_id, depth):
+        for s in children.get(parent_id, []):
+            out.append((s, depth))
+            walk(s["sid"], depth + 1)
+
+    walk(None, 0)
+    return out
+
+
+# The resize phase vocabulary: span-name prefixes -> the budget phase
+# they account to (doc/design_obs.md has the full catalog).
+RESIZE_PHASES = (
+    ("decision", ("scaler.decide", "resize.request")),
+    ("actuation", ("resize.actuate",)),
+    ("restore", ("resize.adopt", "resize.restore_peers")),
+    ("first_fresh_util", ("resize.first_fresh_util",)),
+)
+
+
+def resize_phase_summary(spans: list[dict]) -> list[dict]:
+    """Per-resize-trace phase breakdown: every trace containing a
+    resize-family span becomes ``{trace_id, spans, t0, phases: {phase:
+    seconds}, downtime_s}`` where downtime_s is the restore-phase span
+    time (the measured survivor gap / peer-restore wall time)."""
+    out = []
+    for tid, tspans in sorted(group_traces(spans).items()):
+        names = {s["name"] for s in tspans}
+        if not any(n.startswith(("resize.", "scaler.decide"))
+                   for n in names):
+            continue
+        phases: dict[str, float] = {}
+        for phase, prefixes in RESIZE_PHASES:
+            total = sum(s.get("dur", 0.0) for s in tspans
+                        if s["name"].startswith(prefixes))
+            if total or any(s["name"].startswith(prefixes)
+                            for s in tspans):
+                phases[phase] = round(total, 6)
+        restore = [s for s in tspans
+                   if s["name"].startswith(("resize.adopt",
+                                            "resize.restore_peers"))]
+        out.append({
+            "trace_id": tid,
+            "spans": len(tspans),
+            "t0": min(s.get("t0", 0.0) for s in tspans),
+            "phases": phases,
+            "downtime_s": round(max((s.get("dur", 0.0) for s in restore),
+                                    default=0.0), 6)})
+    return out
+
+
+def to_chrome(spans: list[dict]) -> dict:
+    """Chrome-trace ("Trace Event Format") JSON — loadable in
+    chrome://tracing and Perfetto. Complete ("X") events; each trace id
+    gets a synthetic thread lane so concurrent resizes don't stack."""
+    events = []
+    lanes: dict[str, int] = {}
+    for s in sorted(spans, key=lambda s: s.get("t0", 0.0)):
+        lane = lanes.setdefault(s["tid"], len(lanes) + 1)
+        events.append({
+            "name": s["name"], "ph": "X", "cat": "edl",
+            "ts": round(s.get("t0", 0.0) * 1e6, 1),
+            "dur": max(round(s.get("dur", 0.0) * 1e6, 1), 1.0),
+            "pid": s.get("pid", 0), "tid": lane,
+            "args": dict(s.get("attrs") or {},
+                         trace_id=s["tid"], span_id=s["sid"])})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

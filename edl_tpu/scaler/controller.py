@@ -42,6 +42,8 @@ from typing import Callable
 
 from edl_tpu.coord.collector import Collector
 from edl_tpu.coord.store import Store
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace
 from edl_tpu.scaler.policy import JobView, Proposal, ScalingPolicy
 from edl_tpu.utils.config import field
 from edl_tpu.utils.exceptions import EdlStoreError
@@ -291,6 +293,21 @@ class ScalerController:
         # kicks the next tick instead of waiting out the interval
         self._kick = threading.Event()
         self._util_watches: list = []
+        # decision-plane counters; the journal stays the audit trail,
+        # the obs registry serves the same tallies as gauges
+        self._n_ticks = 0
+        self._n_resizes = 0
+        self._obs = obs_metrics.register_stats("scaler", self.stats)
+
+    def stats(self) -> dict:
+        """Controller counters as a dict view (obs registry source)."""
+        return {"is_leader": self.is_leader(),
+                "ticks": self._n_ticks,
+                "resizes_actuated": self._n_resizes,
+                "jobs": len(self.jobs),
+                "services": len(self.services),
+                "resize_pending": len(self._resize_pending),
+                "journal_seq": self.journal._seq}
 
     # -- observation --------------------------------------------------------
 
@@ -428,6 +445,7 @@ class ScalerController:
         if not self._restored:
             self._restore_from_journal()
         now = self.clock() if now is None else now
+        self._n_ticks += 1
         views = [self.observe(j, now) for j in self.jobs]
         serving_views = [self.observe_service(s) for s in self.services]
         if serving_views and self.serving_policy is None:
@@ -453,9 +471,19 @@ class ScalerController:
                 action = "dry-run"
             else:
                 try:
-                    resp = self._actuate(view.job_id, prop.desired)
+                    # trace root of the resize: the /resize actuation,
+                    # the epoch publication, the surviving trainers'
+                    # adoptions and the peer restores all parent onto
+                    # this span (obs/trace.py propagation contract)
+                    with trace.span("scaler.decide",
+                                    attrs={"job": view.job_id,
+                                           "from": prop.current,
+                                           "to": prop.desired,
+                                           "reason": prop.reason}):
+                        resp = self._actuate(view.job_id, prop.desired)
                     applied = int(resp.get("desired_nodes", prop.desired))
                     action = "resize"
+                    self._n_resizes += 1
                     if resp.get("clamped"):
                         reason += "; clamped by job server"
                     self.policy.notify_resized(view.job_id, applied, now)
@@ -624,3 +652,4 @@ class ScalerController:
         if self.election is not None:
             self.election.resign()
         self.journal.close()
+        obs_metrics.unregister(self._obs)

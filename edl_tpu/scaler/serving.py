@@ -58,6 +58,8 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Protocol, runtime_checkable
 
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import recorder as flight
 from edl_tpu.scaler.policy import Proposal
 from edl_tpu.utils.config import field
 from edl_tpu.utils.logging import get_logger
@@ -377,6 +379,20 @@ class TeacherPoolActuator:
         self.desired = 0                          # guarded-by: _lock
         self.resize_log: list[dict] = []          # guarded-by: _lock
         self.drain_log: list[dict] = []           # guarded-by: _lock
+        # the logs stay the audit API; the obs registry serves the
+        # same tallies as scrapeable gauges (unregistered on close)
+        self._obs = obs_metrics.register_stats("pool", self.stats)
+
+    def stats(self) -> dict:
+        """Pool counters as a dict view (obs registry source)."""
+        with self._lock:
+            return {"teachers": len(self._teachers),
+                    "desired": self.desired,
+                    "spawned_total": self._spawned,
+                    "resizes": len(self.resize_log),
+                    "drains": len(self.drain_log),
+                    "hard_kills": sum(1 for d in self.drain_log
+                                      if d.get("hard_killed"))}
 
     def pool_size(self) -> int:
         with self._lock:
@@ -406,6 +422,8 @@ class TeacherPoolActuator:
                 # keep their warmed caches and long-lived client links
                 victims.append(self._teachers.pop())
             to_spawn = desired - len(self._teachers)
+        flight.record("resize", plane="serving", service=self.service,
+                      frm=cur, to=desired)
         for handle in victims:
             self._begin_drain(handle)
         for _ in range(to_spawn):
@@ -469,6 +487,8 @@ class TeacherPoolActuator:
             log.warning("stopping %s failed: %s", entry["endpoint"], exc)
         with self._lock:
             self.drain_log.append(entry)
+        flight.record("drain", plane="serving", service=self.service,
+                      **entry)
 
     def wait_drains(self, timeout: float = 30.0) -> bool:
         """Join outstanding drain threads (tests, orderly shutdown)."""
@@ -493,6 +513,7 @@ class TeacherPoolActuator:
             except Exception:  # noqa: BLE001 — teardown
                 pass
         self.wait_drains(timeout=5.0)
+        obs_metrics.unregister(self._obs)
 
 
 # -- the jax-free CI smoke ---------------------------------------------------

@@ -52,6 +52,8 @@ import jax
 import numpy as np
 from flax import serialization
 
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import recorder as flight
 from edl_tpu.train import sharded_checkpoint as sc
 from edl_tpu.train.state import TrainStatus
 from edl_tpu.utils.logging import get_logger
@@ -140,6 +142,9 @@ class CheckpointManager:
             "writes": 0, "errors": 0,
             "snapshot_ms_last": 0.0, "save_stall_ms_total": 0.0,
             "write_s_last": 0.0, "write_s_total": 0.0}
+        # the stats() dict stays the benchlog API; the per-process obs
+        # registry serves the same counters as gauges (close() drops it)
+        self._obs = obs_metrics.register_stats("ckpt", self.stats)
 
     @property
     def process_index(self) -> int:
@@ -747,6 +752,10 @@ class CheckpointManager:
         with self._cond:
             self._writer = None
             self._closed = False
+        # drop the registry view (the manager stays usable for saves,
+        # but a closed manager must not pin itself in the per-process
+        # registry forever — tests build thousands of these)
+        obs_metrics.unregister(self._obs)
         if raise_errors:
             self._raise_pending_error()
 
@@ -844,6 +853,8 @@ class CheckpointManager:
         # latest_version() names it) is corrupt: walk older sealed
         # versions, newest first, loudly.
         bad = self.latest_version()
+        flight.record("corruption", plane="checkpoint", version=bad,
+                      directory=self.directory, error=str(last_exc))
         log.error("checkpoint ckpt-%s corrupt (%s) — falling back to "
                   "the previous sealed version", bad, last_exc)
         older = [v for v in self.versions() if bad is None or v < bad]

@@ -18,6 +18,8 @@ from typing import Any, Callable, Iterable
 
 import jax
 
+from edl_tpu.obs import recorder as flight
+from edl_tpu.obs import trace
 from edl_tpu.parallel import mesh as mesh_lib
 from edl_tpu.train.checkpoint import CheckpointManager
 from edl_tpu.train.state import TrainStatus
@@ -179,6 +181,10 @@ class TrainLoop:
         self.last_reform_downtime_s: float | None = None
         self.stop_reason: str | None = None
         self._reform_t0: float | None = None
+        # the in-flight adoption's trace span: opened at reform, ended
+        # at the first step of the new generation — its duration IS the
+        # measured p2p downtime, inside the resize's causal trace
+        self._reform_span = None
 
     # -- checkpoint glue ---------------------------------------------------
 
@@ -247,6 +253,15 @@ class TrainLoop:
         the devices. The measured gap (adoption -> first step of the new
         generation) is the p2p resize downtime for survivors."""
         self._reform_t0 = time.perf_counter()
+        if trace.enabled():
+            from edl_tpu.collective.migration import resize_trace_ctx
+            self._reform_span = trace.start_span(
+                "resize.adopt",
+                parent=resize_trace_ctx(self._migration.store,
+                                        self._migration.job_id),
+                attrs={"pod": self._migration.pod_id,
+                       "rank": reform.rank, "world": reform.world_size,
+                       "generation": reform.generation})
         log.info("live-reform: adopting cluster v%d rank=%d world=%d in "
                  "place (no respawn, no restore)", reform.generation,
                  reform.rank, reform.world_size)
@@ -515,6 +530,16 @@ class TrainLoop:
                         self.restore_source or "fresh",
                         bytes_from_peers=self.bytes_from_peers,
                         restore_s=self.restore_s)
+                    if self.restore_source == "peers" \
+                            and trace.enabled() \
+                            and self._util_publisher is not None:
+                        # a grown pod's first fresh util closes the
+                        # resize trace the same way an adoption's does
+                        from edl_tpu.collective.migration import \
+                            resize_trace_ctx
+                        self._util_publisher.resize_trace = \
+                            resize_trace_ctx(self._migration.store,
+                                             self._migration.job_id)
             if self._reform_t0 is not None:
                 # First step of the adopted generation: force the
                 # dispatch so the measured gap covers real training
@@ -526,6 +551,20 @@ class TrainLoop:
                 log.info("reform-step-complete generation=%d "
                          "downtime_s=%.3f",
                          self._migration.generation, gap)
+                flight.record("resize_adopt",
+                              pod=self._migration.pod_id,
+                              generation=self._migration.generation,
+                              downtime_s=round(gap, 4))
+                if self._reform_span is not None:
+                    # the span covers reform -> first step of the new
+                    # generation: duration == the measured survivor gap
+                    self._reform_span.end(downtime_s=round(gap, 4))
+                    if self._util_publisher is not None:
+                        # first fresh util at the new world closes the
+                        # trace (the scaler's downtime probe signal)
+                        self._util_publisher.resize_trace = \
+                            self._reform_span.context
+                    self._reform_span = None
                 self._migration.ack("adopted", downtime_s=round(gap, 4))
             self.status.step += 1
             self.status.step_in_epoch = i + 1

@@ -138,6 +138,13 @@ ENV_VARS: dict[str, str] = {
     # -- chaos plane ---------------------------------------------------------
     "EDL_TPU_WIRE_STALL_S": "mid-frame wire stall deadline seconds "
                             "(<=0 disables)",
+    # -- observability plane -------------------------------------------------
+    "EDL_TPU_METRICS_PORT": "Prometheus-text scrape endpoint port "
+                            "(0/unset = off)",
+    "EDL_TPU_TRACE": "causal span tracing: 1 = on (sink ./edl_trace), "
+                     "a path = on with that sink dir, 0/unset = off",
+    "EDL_TPU_FLIGHT_RECORDER_N": "flight-recorder ring capacity per "
+                                 "process (0 = off)",
 }
 
 

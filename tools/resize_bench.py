@@ -19,6 +19,11 @@ this by adopting in place — see `elastic_downtime_p2p_s` in bench.py.
 Bytes are what the transport actually moved. Run on any host:
 
   python tools/resize_bench.py --sizes-mb 8 64 256
+
+With ``EDL_TPU_TRACE`` set (obs plane), each p2p row also gets a
+phase-breakdown column derived from the restore's spans — how much of
+the restore term was chunk transfer (``migrate.fetch``) vs planner/
+assembly, and how many chunks crossed the wire.
 """
 
 from __future__ import annotations
@@ -46,6 +51,8 @@ if "xla_force_host_platform_device_count" not in os.environ.get(
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count="
         + os.environ["EDL_TPU_TEST_DEVICES"]).strip()
+
+from edl_tpu.obs import trace  # noqa: E402 — stdlib-only, jax-free
 
 
 def _mesh(n: int):
@@ -85,6 +92,28 @@ def target_like(state, mesh):
 def _median(xs):
     xs = sorted(xs)
     return xs[len(xs) // 2]
+
+
+def _phase_breakdown() -> str | None:
+    """Per-phase split of the last p2p restore, read from the obs span
+    ring (None when tracing is off): wire share of the restore term +
+    chunk count — the column ROADMAP item 2's multi-host budget reads."""
+    if not trace.enabled():
+        return None
+    restores = trace.finished("resize.restore_peers")
+    if not restores:
+        return None
+    total = restores[-1].get("dur", 0.0)
+    fetches = [s for s in trace.finished("migrate.fetch")
+               if s["tid"] == restores[-1]["tid"]]
+    wire = sum(s.get("dur", 0.0) for s in fetches)
+    if total <= 0:
+        return None
+    # fetches run on the restore THREAD POOL, so their summed seconds
+    # legitimately exceed the wall-clock span when reads overlap —
+    # report the sum with a Σ so the column reads as thread-seconds
+    return (f"wire Σ{100 * wire / total:.0f}% of wall "
+            f"({len(fetches)} chunks)")
 
 
 def sweep_size(size_mb: float, src_n: int, directions, trials: int):
@@ -129,20 +158,23 @@ def sweep_size(size_mb: float, src_n: int, directions, trials: int):
                     jax.block_until_ready(out)
                     disk_s.append(time.perf_counter() - t0)
 
-                p2p_s, wire_bytes = [], 0
+                p2p_s, wire_bytes, phases = [], 0, "-"
                 for _ in range(trials):
+                    trace.clear_ring()
                     t0 = time.perf_counter()
                     out, _, stats = mig.restore_from_peers(
                         store, "bench", target)
                     jax.block_until_ready(out)
                     p2p_s.append(time.perf_counter() - t0)
                     wire_bytes = stats["bytes_from_peers"]
+                    phases = _phase_breakdown() or phases
 
                 rows.append((size_mb, "disk", direction,
-                             f"{src_n}->{tgt_n}", _median(disk_s), nbytes))
+                             f"{src_n}->{tgt_n}", _median(disk_s), nbytes,
+                             "-"))
                 rows.append((size_mb, "p2p", direction,
                              f"{src_n}->{tgt_n}", _median(p2p_s),
-                             wire_bytes))
+                             wire_bytes, phases))
 
             # legacy replicated baseline: full msgpack deserialize (no
             # mesh direction — the blob is the whole state)
@@ -152,7 +184,7 @@ def sweep_size(size_mb: float, src_n: int, directions, trials: int):
                 serialization.from_bytes(host, blob)
                 rep_s.append(time.perf_counter() - t0)
             rows.append((size_mb, "disk-rep", "-", "-", _median(rep_s),
-                         len(blob)))
+                         len(blob), "-"))
         finally:
             server.stop()
     finally:
@@ -183,14 +215,16 @@ def main(argv=None) -> int:
 
     print(f"restore term of the resize downtime (median of "
           f"{args.trials}); src mesh = {args.src_devices} devices\n")
-    print("| state | path | direction | mesh | restore s | MB moved |")
-    print("|------:|------|-----------|------|----------:|---------:|")
+    print("| state | path | direction | mesh | restore s | MB moved "
+          "| phases (spans) |")
+    print("|------:|------|-----------|------|----------:|---------:"
+          "|----------------|")
     for size in args.sizes_mb:
         for row in sweep_size(size, args.src_devices, directions,
                               args.trials):
-            size_mb, path, direction, mesh, secs, nbytes = row
+            size_mb, path, direction, mesh, secs, nbytes, phases = row
             print(f"| {size_mb:.0f}MB | {path} | {direction} | {mesh} "
-                  f"| {secs:9.4f} | {nbytes / 2**20:8.1f} |")
+                  f"| {secs:9.4f} | {nbytes / 2**20:8.1f} | {phases} |")
     return 0
 
 
