@@ -1199,6 +1199,67 @@ def bench_elastic_downtime_p2p(on_tpu: bool) -> dict:
     return out
 
 
+def bench_resize_reform(on_tpu: bool) -> dict:
+    """Multi-process resize downtime WITHOUT restart: run
+    `elastic_demo --resize-reform` (2-virtual-device launcher pods
+    whose local dp mesh is sized by the elastic world, scripted shrink
+    + grow, self-audited) and read its machine-readable summary.
+
+    - `elastic_downtime_multihost_s`: the best (compile-cache-warm)
+      surviving-pod gap through a TRUE device-world change — quiesce-
+      seal -> mesh-reform -> peer-restore -> re-jit -> first step, all
+      inside one OS process. The multi-host analogue of
+      `elastic_downtime_p2p_s` (ROADMAP item 2's target: within ~2x).
+    - `elastic_downtime_multihost_cold_s`: the same gap when the new
+      world's shape is seen for the FIRST time — exactly one compile.
+    - `reform_zero_restart`: True iff at least one pod rode two
+      resizes on one pid (the no-process-restart proof the demo exits
+      nonzero without).
+    """
+    import re
+    import subprocess
+    import sys
+
+    del on_tpu  # orchestration-plane measurement: CPU pods, hermetic
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)   # the demo sets its own 2-device world
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = {"elastic_downtime_multihost_s": None,
+           "elastic_downtime_multihost_cold_s": None,
+           "reform_restores_peers": None,
+           "reform_zero_restart": False,
+           "reform_demo_ok": False}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "edl_tpu.examples.elastic_demo",
+             "--resize-reform"],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        m = re.search(r"reform_summary=(\{.*\})", proc.stdout)
+        if not m:
+            print("reform downtime bench: no summary "
+                  f"(rc={proc.returncode})\n{proc.stdout[-2000:]}"
+                  f"\n{proc.stderr[-2000:]}", file=sys.stderr)
+            return out
+        summary = json.loads(m.group(1))
+        out.update({
+            "elastic_downtime_multihost_s":
+                summary.get("elastic_downtime_multihost_s"),
+            "elastic_downtime_multihost_cold_s":
+                summary.get("elastic_downtime_multihost_cold_s"),
+            "reform_restores_peers":
+                summary.get("reform_restores_peers"),
+            "reform_zero_restart":
+                bool(summary.get("zero_restart_survivors")),
+            "reform_demo_ok": bool(summary.get("ok"))
+            and proc.returncode == 0})
+    except (subprocess.SubprocessError, OSError, ValueError) as exc:
+        print(f"reform downtime bench failed: {exc}", file=sys.stderr)
+    return out
+
+
 def bench_scaler(on_tpu: bool) -> dict:
     """Autoscaler decision quality on the deterministic simulator: how
     fast the ThroughputPolicy closes on the oracle allocation and what
@@ -1704,6 +1765,14 @@ def main() -> None:
         p2p["elastic_downtime_reduction_x"] = round(
             downtime["elastic_downtime_s"]
             / p2p["elastic_downtime_p2p_s"], 1)
+    reform = bench_resize_reform(on_tpu)
+    if p2p.get("elastic_downtime_p2p_s") \
+            and reform.get("elastic_downtime_multihost_s"):
+        # ROADMAP item 2's target ratio: a device-world change vs the
+        # unchanged-device-set adoption, same artifact
+        reform["elastic_downtime_multihost_vs_adopt_x"] = round(
+            reform["elastic_downtime_multihost_s"]
+            / p2p["elastic_downtime_p2p_s"], 2)
     scaler = bench_scaler(on_tpu)
     serving_slo = bench_serving_slo(on_tpu)
     control_plane = bench_control_plane(on_tpu)
@@ -1841,6 +1910,11 @@ def main() -> None:
             # disk baseline above): survivors adopt in place, joiners
             # restore from donor memory over the tensor wire
             **p2p,
+            # multi-process resize WITHOUT restart (reform state
+            # machine): survivors ride a true device-world change in
+            # place — warm (cached shape) and cold (one compile) gaps,
+            # same artifact as the single-host numbers above
+            **reform,
             # autoscaler decision plane on the deterministic simulator:
             # ticks-to-converge / vs-oracle gap / downtime paid across
             # concave+flat+knee curves (edl_tpu/scaler)

@@ -20,6 +20,11 @@ Invariants (doc/design_chaos.md maps each to its artifact):
   I4  no hard kills outside the drain deadline (drain_log)
   I5  every injected fault either recovered or surfaced as a typed
       error — never silently unresolved
+  I6  every reform either completes in place or degrades to a clean
+      stop-resume — never a wedge, never a torn world (every
+      `reform_start` in a worker report pairs with a `reform_done`
+      whose result is "in-place" or "stop-resume", unless the worker
+      died mid-ladder — which is a process fault the respawn covers)
 """
 
 from __future__ import annotations
@@ -100,6 +105,17 @@ class InvariantAuditor:
         dup = int(self.probe.get("duplicates", 0))
         if dup:
             rep.breach(f"I1: {dup} duplicate watch deliveries")
+        # Commit-gated fan-out made this a hard invariant (it was a
+        # documented stat before r20): a watcher that observes the same
+        # revision with two different values saw a doomed leader's
+        # uncommitted suffix — which the commit gate must make
+        # impossible. Pinned to ZERO.
+        branch = int(self.probe.get("branch_anomalies", 0))
+        if branch:
+            rep.breach(f"I1: {branch} branch anomalies — a watcher "
+                       "observed uncommitted (later-discarded) entries; "
+                       "commit-gated fan-out is broken")
+        rep.stats["branch_anomalies"] = branch
         # Loss is judged by VALUE, not by (value, revision): across a
         # leader failover a watcher may have observed the deposed
         # leader's uncommitted suffix — entries later discarded and
@@ -252,6 +268,61 @@ class InvariantAuditor:
         rep.stats["faults_injected"] = len(self.injections)
         rep.stats["faults_survived"] = survived
 
+    # -- I6: reform ladders complete or cleanly downgrade --------------------
+
+    # records a reform ladder legitimately writes between its start and
+    # its outcome (the restore halves report through the same rig)
+    _LADDER_KINDS = frozenset({"restore", "ckpt_corrupt_detected",
+                               "ckpt_fallback"})
+    _REFORM_RESULTS = frozenset({"in-place", "stop-resume"})
+
+    def _audit_reforms(self, rep: ChaosReport) -> None:
+        started = completed = downgrades = died = 0
+        for pod, records in self.worker_reports.items():
+            for i, r in enumerate(records):
+                if r.get("kind") != "reform_start":
+                    continue
+                started += 1
+                gen = int(r.get("generation", -1))
+                verdict = None
+                for s in records[i + 1:]:
+                    kind = s.get("kind")
+                    if kind == "reform_done" \
+                            and int(s.get("generation", -1)) >= gen:
+                        verdict = "done"
+                        result = s.get("result")
+                        if result not in self._REFORM_RESULTS:
+                            rep.breach(
+                                f"I6: {pod} reform gen={gen} ended "
+                                f"with unknown result {result!r}")
+                        else:
+                            completed += 1
+                            if result == "stop-resume":
+                                downgrades += 1
+                        break
+                    if kind == "started":
+                        # a fresh incarnation: the worker died
+                        # mid-ladder — a process fault (respawn
+                        # covers it), not a wedge
+                        verdict = "died"
+                        died += 1
+                        break
+                    if kind not in self._LADDER_KINDS:
+                        verdict = "wedged"
+                        rep.breach(
+                            f"I6: {pod} reform gen={gen} neither "
+                            f"completed nor degraded — the worker "
+                            f"moved on ({kind!r}) with the ladder "
+                            "open (torn world)")
+                        break
+                # ladder still in flight when the run froze its
+                # artifacts (no further records): not a wedge — the
+                # settle window bounds how often this can happen
+        rep.stats["reforms_started"] = started
+        rep.stats["reforms_completed"] = completed
+        rep.stats["reform_downgrades"] = downgrades
+        rep.stats["reforms_died_midladder"] = died
+
     def audit(self) -> ChaosReport:
         rep = ChaosReport()
         self._audit_probe(rep)
@@ -259,6 +330,7 @@ class InvariantAuditor:
         self._audit_checkpoints(rep)
         self._audit_drains(rep)
         self._audit_faults(rep)
+        self._audit_reforms(rep)
         typed = sum(1 for recs in self.worker_reports.values()
                     for r in recs if r.get("kind") == "typed_error")
         rep.stats["worker_typed_errors"] = typed

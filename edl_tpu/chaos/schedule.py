@@ -38,6 +38,9 @@ FAULT_CLASSES = (
     "ckpt-corrupt",     # bit-flip/truncate a sealed chunk on disk
     "resize",           # JobServer fault-injected resize (trainer world)
     "pool-resize",      # serving-pool resize through the actuator
+    "reform",           # resize + a mid-phase fault (kill a donor,
+                        # SIGSTOP a survivor, partition the store) —
+                        # the reform state machine's I6 drill
 )
 
 # Per-class weights for the tail of the schedule (the head cycles every
@@ -45,7 +48,7 @@ FAULT_CLASSES = (
 _WEIGHTS = {
     "wire": 4, "process-kill": 3, "process-pause": 2,
     "store-partition": 2, "leader-kill": 1, "ckpt-corrupt": 3,
-    "resize": 2, "pool-resize": 2,
+    "resize": 2, "pool-resize": 2, "reform": 2,
 }
 
 
@@ -91,6 +94,15 @@ def _draw_event(rng: random.Random, fault: str, t: float, *,
     if fault == "pool-resize":
         return FaultEvent(t, "pool-resize", "pool",
                           params={"delta": rng.choice([-1, 1, 1])})
+    if fault == "reform":
+        # a resize immediately compounded with a mid-phase fault: the
+        # workers' reform ladders must complete or cleanly downgrade
+        # under it (InvariantAuditor I6 pairs every start with an end)
+        sub = rng.choice(["kill-donor", "pause-survivor",
+                          "partition-store"])
+        return FaultEvent(t, "reform", "job",
+                          duration=round(rng.uniform(0.5, 1.5), 3),
+                          params={"sub": sub})
     raise ValueError(f"unknown fault class {fault!r}")
 
 

@@ -503,6 +503,36 @@ class SoakWorld:
             elif fault == "resize":
                 snap = self.state.random_resize()
                 rec["desired"] = snap["desired_nodes"]
+            elif fault == "reform":
+                # the compound drill: a resize AND a mid-phase fault —
+                # the workers' reform ladders must complete or cleanly
+                # downgrade under it (the auditor's I6 pairs every
+                # reform_start with its outcome)
+                snap = self.state.random_resize()
+                rec["desired"] = snap["desired_nodes"]
+                sub = event.params.get("sub", "kill-donor")
+                rec["sub"] = sub
+                if sub == "partition-store":
+                    idx = self._resolve_replica("replica:follower")
+                    if idx is not None:
+                        srv = self.replicas[idx]
+                        fl.StorePartitioner.sever(srv.node, True)
+                        rec["replica"] = self.endpoints[idx]
+                        self._pending.append(
+                            (time.monotonic() + event.duration,
+                             "partition-heal", srv.node))
+                else:
+                    slot = self.rng.randrange(self.args.pods)
+                    handle = self.supervisor.handle(slot)
+                    if handle is not None:
+                        rec["slot"] = slot
+                        if sub == "kill-donor":
+                            fl.ProcessChaos.sigkill(handle)
+                        else:
+                            fl.ProcessChaos.sigstop(handle)
+                            self._pending.append(
+                                (time.monotonic() + event.duration,
+                                 "sigcont", handle))
             elif fault == "pool-resize":
                 delta = int(event.params.get("delta", 1))
                 cur = self.pool_journal[-1]["to"]
@@ -655,6 +685,17 @@ class SoakWorld:
                     {"recovered": True} if ok else
                     {"recovered": False,
                      "detail": f"live={live} desired={desired}"})
+            elif fault == "reform":
+                # world converged + the store answers again; the reform
+                # PROTOCOL (every ladder completes or cleanly
+                # downgrades) is I6's job over the worker reports
+                ok = (len(live) == desired and all(live.values())
+                      and probe_ok)
+                inj["resolution"] = (
+                    {"recovered": True} if ok else
+                    {"recovered": False,
+                     "detail": f"live={live} desired={desired} "
+                               f"probe={probe_ok}"})
             elif fault == "pool-resize":
                 want = self.pool_journal[-1]["to"]
                 got = self.actuator.pool_size()

@@ -40,6 +40,8 @@ import numpy as np
 
 from edl_tpu.collective import register as reg
 from edl_tpu.collective.cluster import Cluster, Pod
+from edl_tpu.collective.reform import (ReformConfig, ReformMachine,
+                                       wait_until)
 from edl_tpu.coord.client import StoreClient
 from edl_tpu.coord.collector import util_key
 from edl_tpu.obs import recorder as flight
@@ -164,29 +166,111 @@ class CheckpointRig:
         finally:
             files.close()
 
+    def _quarantine(self, version: int, exc: Exception) -> None:
+        """Typed detection -> quarantine -> the newest GOOD version is
+        the fallback (reported so the auditor can pair detection with
+        the injected corruption)."""
+        self.report("ckpt_corrupt_detected", version=version,
+                    error=str(exc))
+        flight.record("corruption", plane="chaos-rig",
+                      slot=self.slot, version=version, error=str(exc))
+        vdir = os.path.join(self.directory, f"ckpt-{version}")
+        os.rename(vdir, os.path.join(self.directory,
+                                     f"corrupt-{version}"))
+        good = [v for v in self.versions() if v != version]
+        self.report("ckpt_fallback", bad=version,
+                    to=max(good) if good else None)
+
     def verify_all(self) -> None:
         for version in self.versions():
             try:
                 arrays = self._read_version(version)
             except EdlCheckpointCorrupt as exc:
-                # typed detection -> quarantine -> the newest GOOD
-                # version is the fallback (reported so the auditor can
-                # pair detection with the injected corruption)
-                self.report("ckpt_corrupt_detected", version=version,
-                            error=str(exc))
-                flight.record("corruption", plane="chaos-rig",
-                              slot=self.slot, version=version,
-                              error=str(exc))
-                vdir = os.path.join(self.directory, f"ckpt-{version}")
-                os.rename(vdir, os.path.join(self.directory,
-                                             f"corrupt-{version}"))
-                good = [v for v in self.versions() if v != version]
-                self.report("ckpt_fallback", bad=version,
-                            to=max(good) if good else None)
+                self._quarantine(version, exc)
                 continue
             self.report("restore", version=version,
                         digest=_digest(arrays),
                         newest=version == self.versions()[-1])
+
+    # -- reform-ladder restore halves (collective/reform.py executors) ----
+
+    def restore_newest(self) -> dict[str, np.ndarray]:
+        """The ladder's restore phase: crc-verified read of the newest
+        sealed version. `EdlCheckpointCorrupt` propagates — that is the
+        typed peer-restore failure the machine downgrades on."""
+        versions = self.versions()
+        if not versions:
+            raise EdlCheckpointCorrupt("no sealed version to restore")
+        arrays = self._read_version(versions[-1])
+        self.report("restore", version=versions[-1],
+                    digest=_digest(arrays), newest=True)
+        return arrays
+
+    def fallback_previous(self) -> dict[str, np.ndarray]:
+        """The ladder's disk downgrade: quarantine the newest (corrupt)
+        version and restore the previous good one."""
+        versions = self.versions()
+        if not versions:
+            raise EdlCheckpointCorrupt("nothing to fall back to")
+        self._quarantine(versions[-1],
+                         EdlCheckpointCorrupt("reform restore failed"))
+        good = self.versions()
+        if not good:
+            raise EdlCheckpointCorrupt("no good version left")
+        arrays = self._read_version(good[-1])
+        self.report("restore", version=good[-1],
+                    digest=_digest(arrays), newest=False)
+        return arrays
+
+
+def run_reform(store: StoreClient, job: str, pod_id: str, generation: int,
+               rig: CheckpointRig, report: Reporter) -> str:
+    """The worker's reform ladder for one cluster-generation bump: the
+    jax-free half of the reform state machine (collective/reform.py),
+    exercised under the soak's compound `reform` faults. Phases:
+
+      quiesce       no device to settle — a bounded no-op
+      mesh-reform   re-read the leader-published cluster doc at (or
+                    past) the new generation under the phase deadline —
+                    a store partition mid-phase times out into the
+                    typed stop-resume downgrade
+      restore       crc-verified read of the newest sealed version
+                    (corruption downgrades to the previous good one)
+
+    Returns the machine's result; "stop-resume" tells the caller to
+    release + re-claim its rank (the worker-scale clean downgrade —
+    the same membership blip a real stop-resume produces). Every start
+    is reported before the ladder and every outcome after: the pairing
+    IS the I6 invariant the auditor holds.
+    """
+    report("reform_start", generation=generation)
+    config = ReformConfig(quiesce_s=2.0, mesh_s=2.0, restore_s=6.0,
+                          rejit_s=2.0)
+    machine = ReformMachine(generation, config, who=pod_id)
+
+    def mesh_reform(deadline: float) -> None:
+        def check() -> bool:
+            try:
+                rec = store.get(reg.cluster_key(job))
+                if rec is None:
+                    return False
+                return Cluster.from_json(rec.value).version >= generation
+            except (EdlError, OSError, ValueError):
+                return False
+        if not wait_until(check, deadline, interval=0.1):
+            raise EdlError(f"cluster doc unreadable or stale (< v"
+                           f"{generation}) past the mesh deadline")
+
+    machine.run_ladder(
+        quiesce=lambda dl: None,
+        mesh_reform=mesh_reform,
+        restore_peers=lambda dl: rig.restore_newest(),
+        restore_disk=lambda dl: rig.fallback_previous())
+    doc = machine.finish()
+    report("reform_done", generation=generation, result=doc["result"],
+           restore=doc["restore"], error=doc["error"],
+           phases={p["phase"]: p["s"] for p in doc["phases"]})
+    return doc["result"]
 
 
 def run_worker(args) -> int:
@@ -221,6 +305,7 @@ def run_worker(args) -> int:
                                connect_retries=8, retry_interval=0.1)
     last_seal = time.monotonic()
     last_verify = time.monotonic()
+    last_gen: int | None = None  # reform-ladder generation cursor
     try:
         while not stop["flag"]:
             # -- membership: claim once, re-claim whenever the lease dies
@@ -264,6 +349,33 @@ def run_worker(args) -> int:
                             watch_from = max(watch_from,
                                              batch.events[-1].revision)
                     batch = watch.get(timeout=0.0)
+            # -- reform ladder: a cluster-generation bump that keeps
+            # this pod is a device-world change it must ride through in
+            # place (or cleanly downgrade out of) — never a wedge (I6)
+            try:
+                _world_now, gen_now = _cluster_world(store, args.job)
+            except (EdlError, OSError, ValueError):
+                gen_now = None
+            if gen_now is not None:
+                if last_gen is None:
+                    last_gen = gen_now  # the baseline generation
+                elif gen_now > last_gen:
+                    last_gen = gen_now
+                    if rank is not None:
+                        result = run_reform(store, args.job, args.pod_id,
+                                            gen_now, rig, report)
+                        if result == "stop-resume":
+                            # the clean downgrade at worker scale:
+                            # release + re-claim (a real membership
+                            # blip; the barrier re-forms the world)
+                            try:
+                                register.release()
+                            except (EdlError, OSError):
+                                pass
+                            register = reg.PodRegister(
+                                store, args.job, pod,
+                                max_nodes=args.max_nodes, ttl=args.ttl)
+                            rank = None
             # -- utilization: what the autoscaler's collector digests
             try:
                 world, generation = _cluster_world(store, args.job)

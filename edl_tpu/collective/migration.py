@@ -45,6 +45,7 @@ Store key layout (all under the job scope):
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
@@ -372,16 +373,22 @@ def resize_trace_ctx(store: Store, job_id: str) -> tuple[str, str] | None:
 def restore_from_peers(store: Store, job_id: str, target: Any, *,
                        local_version: int | None = None,
                        threads: int | None = None,
-                       timeout: float = 5.0) -> tuple[Any, Any, dict]:
+                       timeout: float = 5.0,
+                       pods: list[str] | None = None
+                       ) -> tuple[Any, Any, dict]:
     """Assemble ``target``'s state from live donor snapshots (traced:
     the restore runs as a ``resize.restore_peers`` span parented onto
-    the resize that caused it, with per-chunk fetch child spans)."""
+    the resize that caused it, with per-chunk fetch child spans).
+    ``pods`` restricts the donor set — the reform state machine's
+    survivor restores its OWN just-sealed shards this way (per-pod
+    checkpoint version counters are not comparable across pods, so an
+    unfiltered merge could interleave states from different steps)."""
     with trace.span("resize.restore_peers",
                     parent=resize_trace_ctx(store, job_id),
                     attrs={"job": job_id}) as sp:
         state, status, stats = _restore_from_peers(
             store, job_id, target, local_version=local_version,
-            threads=threads, timeout=timeout)
+            threads=threads, timeout=timeout, pods=pods)
         if sp is not None:
             sp.attrs.update({k: stats[k] for k in
                              ("version", "bytes_from_peers", "restore_s")})
@@ -395,7 +402,9 @@ def restore_from_peers(store: Store, job_id: str, target: Any, *,
 def _restore_from_peers(store: Store, job_id: str, target: Any, *,
                         local_version: int | None = None,
                         threads: int | None = None,
-                        timeout: float = 5.0) -> tuple[Any, Any, dict]:
+                        timeout: float = 5.0,
+                        pods: list[str] | None = None
+                        ) -> tuple[Any, Any, dict]:
     """Assemble ``target``'s state from live donor snapshots.
 
     Donor adverts are read from the store, the newest advertised version
@@ -414,8 +423,12 @@ def _restore_from_peers(store: Store, job_id: str, target: Any, *,
     from edl_tpu.train.state import TrainStatus
 
     adverts = live_donors(store, job_id)
+    if pods is not None:
+        adverts = [a for a in adverts if a.get("pod_id") in pods]
     if not adverts:
-        raise PeerRestoreError("no live donors advertised")
+        raise PeerRestoreError(
+            "no live donors advertised" if pods is None else
+            f"no live donors among {pods}")
     # The advert is DISCOVERY only — the manifest carries the live
     # sealed version (adverts refresh off-thread and may lag a seal).
     manifests: dict[str, dict] = {}
@@ -611,6 +624,27 @@ class MigrationService:
                 log.warning("donor advert publish failed: %s", exc)
                 self._lease = None
 
+    def flush_advert(self) -> bool:
+        """Publish the donor advert for the current sealed snapshot NOW,
+        on the calling thread (the off-thread advert loop's cadence is
+        fine for steady-state serving but the reform quiesce phase needs
+        its fresh seal discoverable before peer-restore starts). False
+        when there is nothing to advertise or the put failed."""
+        self._on_sealed()
+        with self._lock:
+            doc = self._advert_doc
+        if doc is None:
+            return False
+        try:
+            self.store.put(donor_key(self.job_id, self.pod_id),
+                           json.dumps(doc, sort_keys=True),
+                           lease=self._ensure_lease())
+            return True
+        except Exception as exc:  # noqa: BLE001 — best-effort, the
+            # advert loop retries; the caller falls back to disk
+            log.warning("synchronous donor advert failed: %s", exc)
+            return False
+
     def _ensure_lease(self) -> int:
         if self._lease is not None and self._keeper is not None \
                 and not self._keeper.lost.is_set():
@@ -699,32 +733,72 @@ class MigrationService:
 
     # -- acks --------------------------------------------------------------
 
+    def live_generation(self) -> int | None:
+        """The cluster generation the leader has published (the epoch
+        authority adoption acks are fenced against); None when the doc
+        is unreadable — fencing then degrades open, the launcher-side
+        `wait_adopted` generation check is the second fence."""
+        from edl_tpu.collective import register as reg
+        from edl_tpu.collective.cluster import Cluster
+        try:
+            rec = self.store.get(reg.cluster_key(self.job_id))
+            if rec is None:
+                return None
+            return Cluster.from_json(rec.value).version
+        except Exception:  # noqa: BLE001 — transient store error
+            return None
+
     def ack(self, mode: str, *, version: int | None = None,
             downtime_s: float | None = None, bytes_from_peers: int = 0,
-            restore_s: float | None = None) -> None:
+            restore_s: float | None = None, generation: int | None = None,
+            reform: dict | None = None) -> bool:
         """Record that this pod is trained-and-running in the current
         generation (written AFTER the first post-restore/post-adoption
         step): what lingering donors key their early exit on, and what
-        the demo/bench read the measured downtime from."""
+        the demo/bench read the measured downtime from.
+
+        Adoption acks are **generation-fenced**: a survivor that
+        finished reforming into generation G while the leader has
+        already published G' > G is half-reformed against a dead world
+        — its ack BOUNCES (False, nothing written, flight-recorded)
+        instead of convincing the launcher that a torn world is
+        healthy. `wait_adopted` independently requires generation >=
+        the awaited one, so both halves of the fence must agree before
+        an adoption counts."""
+        gen = self.generation if generation is None else generation
+        if mode == "adopted":
+            live = self.live_generation()
+            if live is not None and live > gen:
+                log.warning("stale adoption ack bounced: generation %d "
+                            "< live cluster generation %d", gen, live)
+                flight.record("reform", who=self.pod_id, stale_ack=True,
+                              generation=gen, live_generation=live)
+                return False
         doc = {"pod_id": self.pod_id, "mode": mode, "ts": time.time(),
-               "generation": self.generation, "version": version,
+               "pid": os.getpid(),
+               "generation": gen, "version": version,
                "downtime_s": downtime_s,
                "bytes_from_peers": int(bytes_from_peers),
                "restore_s": restore_s}
+        if reform is not None:
+            doc["reform"] = reform
         try:
             self.store.put(ack_key(self.job_id, self.pod_id),
                            json.dumps(doc, sort_keys=True))
+            return True
         except Exception as exc:  # noqa: BLE001 — observability only
             log.warning("migration ack failed: %s", exc)
+            return False
 
     # -- restore (consumer side) -------------------------------------------
 
     def restore_from_peers(self, target: Any, *,
                            local_version: int | None = None,
-                           threads: int | None = None):
+                           threads: int | None = None,
+                           pods: list[str] | None = None):
         return restore_from_peers(self.store, self.job_id, target,
                                   local_version=local_version,
-                                  threads=threads)
+                                  threads=threads, pods=pods)
 
     # -- lifecycle ---------------------------------------------------------
 

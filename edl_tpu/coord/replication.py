@@ -363,6 +363,14 @@ class ReplicaNode:
         self._snapshot_installs = 0        # guarded-by: _state_lock
         self._obs = obs_metrics.register_stats("replica", self.stats)
         self.store.set_passive(True)
+        # Commit-gated watch fan-out: a replicated store's watchers
+        # (local AND wire-served, leader AND follower) only ever see
+        # events at or below the majority-committed revision — a doomed
+        # leader's uncommitted suffix is buffered, then discarded by the
+        # snapshot rejoin, so no watcher can observe revisions a new
+        # reign will reuse (closes the r18 branch anomaly;
+        # doc/design_coord.md).
+        self.store.set_fanout_gate(True)
         self.quorum = QuorumLease(self)
 
     # -- lifecycle ----------------------------------------------------------
@@ -450,8 +458,13 @@ class ReplicaNode:
 
     def sweep(self) -> None:
         """Called by the hosting StoreServer's sweeper: the election
-        sidecar expires leases even while the data store is passive."""
+        sidecar expires leases even while the data store is passive.
+        A leader also advances the commit gate here — the net that
+        releases lease-expiry DELETEs (and single-replica groups, which
+        have no sender acks) to watchers on a bounded cadence."""
         self.elect.sweep()
+        if self.role() == "leader":
+            self._advance_fanout()
 
     def _elect_client(self, endpoint: str) -> _ElectClient:
         client = self._elect_clients.get(endpoint)
@@ -582,6 +595,9 @@ class ReplicaNode:
         # active mode: resume lease-expiry duty; every lease clock
         # restarts at now+ttl (late expiry is safe, early is not)
         self.store.set_passive(False)
+        # a new reign's local log IS the committed baseline (divergent
+        # peers rejoin via snapshot): open the fan-out gate up to it
+        self.store.release_fanout(self.store.current_revision)
         with self._commit_cond:
             self._match = {}
             self._recompute_commit_locked()
@@ -663,6 +679,18 @@ class ReplicaNode:
         with self._commit_cond:
             self._match[peer] = max(self._match.get(peer, 0), rev)
             self._recompute_commit_locked()
+            commit = self._commit_rev
+        # commit advanced (or held): release watch fan-out up to it —
+        # outside the condition so the lock order stays commit_cond ->
+        # store lock in one direction only
+        self.store.release_fanout(commit)
+
+    def _advance_fanout(self) -> None:
+        """Recompute the commit point and release watch fan-out to it."""
+        with self._commit_cond:
+            self._recompute_commit_locked()
+            commit = self._commit_rev
+        self.store.release_fanout(commit)
 
     def _recompute_commit_locked(self) -> None:  # holds-lock: _commit_cond
         revs = [self.store.current_revision]
@@ -862,6 +890,10 @@ class ReplicaNode:
                 self.store.apply_lease(int(entry[1]), float(entry[2]))
             elif kind == "LEASE_GONE":
                 self.store.apply_lease_gone(int(entry[1]))
+        # follower-side commit gate: the leader's append carries its
+        # commit point; everything at or below it is safe to fan out
+        # (release_fanout clamps to what was actually applied here)
+        self.store.release_fanout(int(req.get("commit", 0)))
         return {"ok": True, "revision": self.store.current_revision,
                 "term": self.term()}
 
@@ -946,9 +978,14 @@ class ReplicaNode:
         # ambiguity etcd surfaces on a commit timeout — so the error
         # says so instead of pretending the write vanished.
         if not self._wait_commit(rev):
+            # NOT released to watchers: the suffix stays behind the
+            # commit gate — it either commits later (a sender ack
+            # releases it) or dies with this reign (snapshot rejoin
+            # discards it), so no watcher ever saw the ambiguity
             return {"ok": False, "error":
                     "EdlStoreError: replication commit timeout — write "
                     "not acknowledged at majority (may still commit)"}
+        self.store.release_fanout(rev)
         return resp
 
 

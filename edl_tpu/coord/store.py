@@ -148,6 +148,11 @@ class InMemWatch(Watch):
         self._store = store
         self.prefix = prefix
         self._max = max_pending
+        # Resume fence: events at or below this revision are already in
+        # the subscriber's hands (its start_revision) and must never be
+        # re-delivered — including by a commit-gate release of entries
+        # that were applied-but-unreleased when the watcher resumed.
+        self.min_revision = 0
         self._cond = threading.Condition()
         self._queue: deque[WatchBatch] = deque()  # guarded-by: _cond
         self._pending_events = 0                  # guarded-by: _cond
@@ -202,7 +207,7 @@ class InMemWatch(Watch):
             with self._cond:
                 if self._queue or self._cancelled:
                     return None
-                return self._store._revision
+                return self._store._visible_revision_locked()
 
     def cancel(self) -> None:
         self._store._unwatch(self)
@@ -299,6 +304,17 @@ class InMemStore(Store):
         # queues (the obs registry's view of the push plane)
         self._fanout_events = 0               # guarded-by: _lock
         self._expired_leases = 0              # guarded-by: _lock
+        # Commit-gated watch fan-out (replicated stores only): when
+        # gated, _emit buffers events instead of pushing them, and
+        # release_fanout(commit_rev) delivers everything at or below
+        # the majority-committed revision. Watchers therefore never
+        # observe a doomed leader's uncommitted suffix — entries a
+        # failover discards and whose revision numbers the next reign
+        # reuses (the r18 branch anomaly, now closed). Ungated stores
+        # (the default) are unchanged: fan-out at apply time.
+        self._gated = False                   # guarded-by: _lock
+        self._gate_rev = 0                    # guarded-by: _lock
+        self._pending_fanout: deque[Event] = deque()  # guarded-by: _lock
 
     # -- internals ---------------------------------------------------------
 
@@ -312,8 +328,15 @@ class InMemStore(Store):
             drop = len(self._events) - self._max_events
             self._first_event_rev = self._events[drop].revision
             del self._events[:drop]
+        if self._gated and ev.revision > self._gate_rev:
+            self._pending_fanout.append(ev)
+            return
+        self._fanout_push(ev)
+
+    def _fanout_push(self, ev: Event) -> None:  # holds-lock: _lock
         for watcher in self._watchers:
-            if ev.key.startswith(watcher.prefix):
+            if ev.key.startswith(watcher.prefix) \
+                    and ev.revision > watcher.min_revision:
                 watcher._push(ev)
                 self._fanout_events += 1
 
@@ -478,6 +501,51 @@ class InMemStore(Store):
         with self._lock:
             self._expire()
 
+    # -- commit-gated fan-out (replicated stores) ----------------------------
+
+    def set_fanout_gate(self, gated: bool) -> None:
+        """Turn commit-gated watch delivery on/off. On enable, the gate
+        starts at the current revision (everything already applied is
+        considered committed — the replica plane enables the gate at
+        construction, before any traffic). Disabling releases whatever
+        is pending."""
+        with self._lock:
+            if self._gated == gated:
+                return
+            self._gated = gated
+            self._gate_rev = self._revision
+            if not gated:
+                while self._pending_fanout:
+                    self._fanout_push(self._pending_fanout.popleft())
+
+    @property
+    def fanout_gated(self) -> bool:
+        with self._lock:
+            return self._gated
+
+    def release_fanout(self, revision: int) -> None:
+        """Deliver buffered events up to ``revision`` (the majority-
+        committed revision, supplied by the replica plane). Idempotent;
+        a revision ahead of the local log clamps to what exists."""
+        with self._lock:
+            if not self._gated:
+                return
+            revision = min(revision, self._revision)
+            if revision <= self._gate_rev:
+                return
+            self._gate_rev = revision
+            while self._pending_fanout \
+                    and self._pending_fanout[0].revision <= revision:
+                self._fanout_push(self._pending_fanout.popleft())
+
+    def _visible_revision_locked(self) -> int:  # holds-lock: _lock
+        """The revision watchers may use as a resume anchor: everything
+        at or below it has been (or could have been) delivered. Gated
+        stores answer the commit gate, not the raw apply point — an
+        anchor past the gate could skip a reused revision after
+        failover."""
+        return self._gate_rev if self._gated else self._revision
+
     # -- watches -------------------------------------------------------------
 
     def watch(self, prefix: str = "", start_revision: int | None = None,
@@ -485,13 +553,20 @@ class InMemStore(Store):
         with self._lock:
             self._expire()
             watcher = InMemWatch(self, prefix, max_pending)
-            watcher.created_revision = self._revision
+            watcher.created_revision = self._visible_revision_locked()
             if start_revision is not None:
+                watcher.min_revision = start_revision
                 if start_revision + 1 < self._first_event_rev:
-                    watcher._push_compacted(self._revision)
+                    watcher._push_compacted(self._visible_revision_locked())
                 else:
+                    # gated: replay only the committed prefix — the
+                    # uncommitted tail is exactly _pending_fanout and
+                    # will be pushed to this (now registered) watcher
+                    # when the commit gate advances over it
+                    horizon = self._gate_rev if self._gated \
+                        else self._revision
                     for ev in self._events:
-                        if ev.revision > start_revision \
+                        if start_revision < ev.revision <= horizon \
                                 and ev.key.startswith(prefix):
                             watcher._push(ev)
             self._watchers.append(watcher)
@@ -521,6 +596,8 @@ class InMemStore(Store):
                     "watchers": len(self._watchers),
                     "watch_fanout_events": self._fanout_events,
                     "events_buffered": len(self._events),
+                    "fanout_gated": self._gated,
+                    "fanout_pending": len(self._pending_fanout),
                     "passive": self._passive}
 
     # -- replication raw-apply (coord/replication.py) ------------------------
@@ -645,5 +722,11 @@ class InMemStore(Store):
             self._revision = max(self._revision, int(doc.get("revision", 0)))
             self._events = []
             self._first_event_rev = self._revision + 1
+            # a gated store's buffered-but-unreleased tail is exactly
+            # the divergent suffix a snapshot rejoin discards: drop it
+            # (watchers resync via the compacted batch below and never
+            # see the doomed branch)
+            self._pending_fanout.clear()
+            self._gate_rev = self._revision
             for watcher in self._watchers:
                 watcher._push_compacted(self._revision)

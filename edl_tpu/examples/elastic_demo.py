@@ -573,6 +573,229 @@ def run_p2p_demo(args) -> int:
     return 0 if ok else 1
 
 
+def run_reform_demo(args) -> int:
+    """Multi-host resize WITHOUT restart, end-to-end on one host: the
+    reform-state-machine loop (collective/reform.py). Pods run with TWO
+    virtual CPU devices and a local dp mesh sized by the elastic world
+    (``--local-mesh-by-world``), so every resize is a true device-world
+    change for every survivor: the surviving OS process quiesce-seals
+    its live state, re-forms its mesh, restores reshaped state from
+    peers over the tensor wire, re-jits (under the in-process jit cache
+    + ``EDL_TPU_COMPILE_CACHE_DIR``), steps, and acks — generation-
+    fenced. Scripted shrink + grow through /resize; self-audits:
+
+      - at least TWO in-place reforms completed (result "in-place"
+        with the full phase ladder in the adoption ack),
+      - at least one pod rode BOTH resizes on the SAME pid — a
+        multi-process resize with zero process restarts,
+      - at least one reform restored its reshaped state FROM PEERS
+        with bytes over the wire (disk is only the typed fallback),
+      - the job still completes on the final world.
+
+    Prints ``reform_summary=``: `elastic_downtime_multihost_s` is the
+    best (compile-cache-warm) survivor gap — the steady-state cost of a
+    device-world change; `_cold_s` is the worst (first sight of a new
+    shape pays exactly one compile). bench.py and the resize_bench
+    world axis read both.
+    """
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    from edl_tpu.collective import migration as mig
+    from edl_tpu.collective import register as reg
+    from edl_tpu.collective.barrier import read_cluster
+    from edl_tpu.collective.job_server import (JobClient, JobServer,
+                                               JobState, request_resize)
+    from edl_tpu.coord.server import StoreServer
+
+    # the pods are CPU trainers; TWO virtual devices each so the local
+    # mesh can genuinely change size across resizes
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_NUM_CPU_DEVICES"] = "2"
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # jax < 0.5 reads the XLA flag, not JAX_NUM_CPU_DEVICES
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
+    os.environ.setdefault("EDL_TPU_BARRIER_STABLE", "0.5")
+    os.environ.setdefault("EDL_TPU_LEASE_TTL", "3.0")
+    os.environ["EDL_TPU_RESIZE_P2P"] = "1"
+    # reforms pay seal + restore + re-jit: give the launcher's adoption
+    # fence room beyond the default 10s on a busy 1-core host
+    os.environ.setdefault("EDL_TPU_ADOPT_TIMEOUT", "30")
+
+    job_id = "reform_demo"
+    lo, hi = (int(x) for x in args.nodes_range.split(":"))
+    if hi < 2:
+        hi = 2
+    tmp = tempfile.mkdtemp(prefix="edl-reform-demo-")
+    # persistent XLA cache: a respawned pod (and any repeat shape)
+    # skips its re-jits — the knob the re-jit phase is built around
+    os.environ.setdefault("EDL_TPU_COMPILE_CACHE_DIR",
+                          os.path.join(tmp, "xla-cache"))
+    srv = StoreServer(port=0, host="127.0.0.1", sweep_interval=0.2).start()
+    store_ep = f"127.0.0.1:{srv.port}"
+    state = JobState(job_id, lo, hi, desired=hi, store=srv.store)
+    server = JobServer(state, port=0).start()
+    epochs = max(args.epochs, 30)
+    steps = max(args.steps_per_epoch, 20)
+    step_time = args.step_time or 0.05
+    trainer_cmd = [
+        sys.executable, "-m", "edl_tpu.collective.launch",
+        "--store", store_ep, "--job-id", job_id,
+        "--nodes-range", f"{lo}:{hi}",
+        "--checkpoint-path", os.path.join(tmp, "ckpt"),
+        "--log-dir", os.path.join(tmp, "log"), "--",
+        sys.executable, "-m", "edl_tpu.examples.elastic_demo",
+        "--epochs", str(epochs), "--steps-per-epoch", str(steps),
+        "--batch", str(args.batch), "--step-time", str(step_time),
+        "--local-mesh-by-world",
+        "--ckpt-steps", str(args.ckpt_steps or 10)]
+    client = JobClient(f"127.0.0.1:{server.port}", trainer_cmd, poll=0.5)
+    client_thread = threading.Thread(target=client.run, daemon=True,
+                                     name="reform-demo-jobclient")
+
+    acks: dict[tuple, dict] = {}   # (pod_id, ts) -> ack doc
+
+    def sample_acks() -> None:
+        records, _ = srv.store.get_prefix(mig.ack_prefix(job_id))
+        for rec in records:
+            try:
+                doc = json.loads(rec.value)
+                acks[(doc["pod_id"], doc["ts"])] = doc
+            except (ValueError, KeyError):
+                continue
+
+    def wait_for(pred, timeout, what) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            sample_acks()
+            if pred():
+                return True
+            time.sleep(0.25)
+        log.error("reform demo: timeout waiting for %s", what)
+        return False
+
+    def world() -> int:
+        c = read_cluster(srv.store, job_id)
+        return c.world_size if c is not None else 0
+
+    def reform_acks(after: float) -> list[dict]:
+        return [d for d in acks.values()
+                if d["mode"] == "adopted" and d["ts"] > after
+                and (d.get("reform") or {}).get("result") == "in-place"]
+
+    phases_ok = True
+    complete = False
+    t_shrink = t_grow = None
+    try:
+        client_thread.start()
+        phases_ok &= wait_for(
+            lambda: world() == hi and mig.live_donors(srv.store, job_id),
+            args.p2p_timeout, "world up with live donors")
+        if phases_ok:
+            # shrink: every survivor's local mesh GROWS (world hi -> lo
+            # frees devices per pod) — a device-world change they must
+            # reform through in place
+            t_shrink = time.time()
+            request_resize(f"127.0.0.1:{server.port}", lo)
+            phases_ok &= wait_for(
+                lambda: world() == lo and reform_acks(t_shrink),
+                args.p2p_timeout, "shrink reformed in place")
+        if phases_ok:
+            time.sleep(1.5)  # survivors seal fresh versions
+            # grow: survivors reform BACK to an already-seen shape (the
+            # compile-cache-hot path) while the new pod restores from
+            # peers through a full respawn
+            t_grow = time.time()
+            request_resize(f"127.0.0.1:{server.port}", hi)
+            phases_ok &= wait_for(
+                lambda: world() == hi and reform_acks(t_grow) and any(
+                    d["mode"] == "peers" and d["ts"] > t_grow
+                    for d in acks.values()),
+                args.p2p_timeout, "grow reformed + peer-restored")
+        if phases_ok:
+            complete = wait_for(
+                lambda: srv.store.get(reg.complete_key(job_id))
+                is not None,
+                args.p2p_timeout + epochs * steps * step_time,
+                "job completion")
+        sample_acks()
+    finally:
+        client.stop()
+        client_thread.join(timeout=15)
+        for p in client.procs:  # belt and braces: no orphan launchers
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+        srv.stop()
+
+    reforms = [d for d in acks.values()
+               if d["mode"] == "adopted"
+               and (d.get("reform") or {}).get("result") == "in-place"]
+    peer_reforms = [d for d in reforms
+                    if d["reform"].get("restore") == "peers"]
+    disk_reforms = [d for d in reforms
+                    if d["reform"].get("restore") == "disk"]
+    respawn_restores = [d for d in acks.values() if d["mode"] == "peers"]
+    # zero-restart proof: one pod rode >=2 generations on ONE pid while
+    # the world was multi-process
+    by_pod: dict[str, set] = {}
+    for d in reforms:
+        by_pod.setdefault(d["pod_id"], set()).add(
+            (d.get("pid"), d.get("generation")))
+    survivors = [pod for pod, gens in by_pod.items()
+                 if len({g for _, g in gens}) >= 2
+                 and len({p for p, _ in gens}) == 1]
+    bytes_from_peers = sum(d.get("bytes_from_peers") or 0
+                           for d in reforms + respawn_restores)
+    gaps = sorted(d["downtime_s"] for d in reforms
+                  if d.get("downtime_s") is not None)
+    # respawned-pod gap: the stop-resume price a NON-surviving process
+    # pays on the same resize (resize_bench's world-axis column)
+    respawn_gaps = sorted(d["ts"] - t_grow for d in respawn_restores
+                          if t_grow is not None and d["ts"] > t_grow)
+    ok = (phases_ok and complete and len(reforms) >= 2
+          and len(survivors) >= 1 and len(peer_reforms) >= 1
+          and bytes_from_peers > 0)
+    last_reform = max(reforms, key=lambda d: d["ts"])["reform"] \
+        if reforms else None
+    summary = {
+        "ok": ok, "complete": complete,
+        "reforms_in_place": len(reforms),
+        "reform_restores_peers": len(peer_reforms),
+        "reform_restores_disk": len(disk_reforms),
+        "respawn_peer_restores": len(respawn_restores),
+        "zero_restart_survivors": survivors,
+        "resize_bytes_from_peers": bytes_from_peers,
+        # best gap = compile-cache-warm reform (the steady state);
+        # worst = first sight of a new shape (exactly one compile)
+        "elastic_downtime_multihost_s": round(gaps[0], 4) if gaps
+        else None,
+        "elastic_downtime_multihost_cold_s": round(gaps[-1], 4) if gaps
+        else None,
+        "reform_gaps_s": [round(g, 4) for g in gaps],
+        "respawn_downtime_s": round(respawn_gaps[0], 4)
+        if respawn_gaps else None,
+        "last_reform": last_reform,
+        "migration_epochs_published": state._migration_epoch,
+        "served_resizes": state.resize_log}
+    log.info("reform demo done: %s", summary)
+    if not ok:
+        log.error("reform audit failed: reforms=%d survivors=%s "
+                  "peer_reforms=%d bytes=%d complete=%s", len(reforms),
+                  survivors, len(peer_reforms), bytes_from_peers,
+                  complete)
+    print("reform_summary=" + json.dumps(summary), flush=True)
+    shutil.rmtree(tmp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--epochs", type=int, default=5)
@@ -614,14 +837,29 @@ def main(argv=None) -> int:
                              "self-audited p2p adoption + peer restore")
     parser.add_argument("--p2p-timeout", type=float, default=120.0,
                         help="--resize-p2p: per-phase timeout seconds")
+    # reform state-machine demo (see run_reform_demo)
+    parser.add_argument("--resize-reform", action="store_true",
+                        help="run the multi-host-resize-without-restart "
+                             "loop: 2-device pods whose local mesh is "
+                             "sized by the elastic world, scripted "
+                             "shrink/grow, self-audited in-place "
+                             "reforms with zero process restarts")
+    parser.add_argument("--local-mesh-by-world", action="store_true",
+                        help="trainer mode for --resize-reform: local "
+                             "dp mesh sized by the elastic world, "
+                             "reform state machine wired (per-pod ckpt "
+                             "subdirs)")
     args = parser.parse_args(argv)
-    if sum((args.scaler, args.resize_p2p, args.serve_scaler)) > 1:
-        parser.error("--scaler, --serve-scaler and --resize-p2p are "
-                     "separate demos")
+    if sum((args.scaler, args.resize_p2p, args.serve_scaler,
+            args.resize_reform)) > 1:
+        parser.error("--scaler, --serve-scaler, --resize-p2p and "
+                     "--resize-reform are separate demos")
     if args.serve_scaler:
         return run_serve_scaler_demo(args)
     if args.resize_p2p:
         return run_p2p_demo(args)
+    if args.resize_reform:
+        return run_reform_demo(args)
     if args.scaler:
         return run_scaler_demo(args)
 
@@ -633,6 +871,45 @@ def main(argv=None) -> int:
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 1)))["params"]
     state = TrainState.create(apply_fn=model.apply, params=params,
                               tx=optax.sgd(0.05))
+
+    # --local-mesh-by-world: the reform-state-machine trainer shape.
+    # The local dp mesh is a FUNCTION of the elastic world (world 1 ->
+    # all local devices, world w -> ndev // w), so a resize is a true
+    # device-world change for every survivor: the reform_mesh hook
+    # returns the new mesh and the TrainLoop walks quiesce ->
+    # mesh-reform -> peer-restore -> re-jit in place (no respawn).
+    # Each pod checkpoints under its own subdir — per-pod version
+    # counters are per-lineage, and the reform restore is self-scoped.
+    reform_kwargs: dict = {}
+    if args.local_mesh_by_world:
+        from jax.sharding import Mesh
+        from edl_tpu.parallel import mesh as mesh_lib
+
+        def _mesh_for(world: int) -> "Mesh":
+            devices = jax.devices()
+            n = len(devices) if world <= 1 \
+                else max(1, len(devices) // world)
+            return Mesh(np.array(devices[:n]), ("dp",))
+
+        mesh_holder = {"mesh": _mesh_for(env.world_size)}
+
+        def reform_mesh(rank, world, cluster):
+            new = _mesh_for(world)
+            if new.devices.size == mesh_holder["mesh"].devices.size:
+                return None  # device world unchanged: fast adoption
+            mesh_holder["mesh"] = new
+            return new
+
+        # place the INITIAL state exactly the way a reform re-places it
+        # (replicated NamedSharding on the live mesh): the jit cache
+        # then keys identically when a later reform revisits this
+        # shape — the compile-cache-hit path the re-jit phase banks on
+        state = mesh_lib.replicate_host_tree(mesh_holder["mesh"], state)
+        reform_kwargs = {
+            "mesh": mesh_holder["mesh"], "batch_axes": ("dp",),
+            "place_state": lambda t: mesh_lib.replicate_host_tree(
+                mesh_holder["mesh"], t),
+            "reform_mesh": reform_mesh}
 
     def loss_fn(state, params, batch):
         pred = state.apply_fn({"params": params}, batch["x"])
@@ -660,11 +937,15 @@ def main(argv=None) -> int:
         env.rank, env.world_size = rank, world
         env.cluster_version = cluster.version
 
+    ckpt_dir = env.checkpoint_path or None
+    if ckpt_dir and args.local_mesh_by_world and env.pod_id:
+        import os
+        ckpt_dir = os.path.join(ckpt_dir, env.pod_id)
     loop = TrainLoop(step, state, config=from_env(
         LoopConfig, num_epochs=args.epochs,
-        ckpt_dir=env.checkpoint_path or None,
+        ckpt_dir=ckpt_dir,
         log_every_steps=args.steps_per_epoch, **ckpt_kw),
-        on_reform=on_reform)
+        on_reform=on_reform, **reform_kwargs)
     status = loop.run(lambda epoch: make_data(
         epoch, env.rank, env.world_size, args.steps_per_epoch, args.batch))
 

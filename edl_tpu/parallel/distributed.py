@@ -174,6 +174,44 @@ def make_mesh_from_env(spec=None, env: TrainerEnv | None = None,
     return mesh_lib.make_mesh(spec, devices=devices)
 
 
+def reform_world(env: TrainerEnv) -> TrainerEnv:
+    """Tear down the collective layer and re-form it with a NEW topology
+    — the mesh-re-formation primitive of the reform state machine
+    (collective/reform.py): a surviving process keeps running, drops
+    only `jax.distributed`, and rejoins the re-formed world under its
+    new (rank, world, coordinator). The persistent compilation cache is
+    (re)enabled first so the re-formed world's unchanged programs skip
+    their re-jits — a genuinely-new shape costs exactly one compile.
+
+    Single-process worlds (world_size <= 1) only tear down; there is
+    nothing to rejoin — the caller rebuilds its local mesh and the
+    in-process jit cache carries the re-jit story.
+
+    Failures (a coordinator that never comes up, a runtime that cannot
+    re-initialize) surface as the typed ``EdlError`` the reform
+    machine's mesh-reform phase downgrades on — never a bare crash.
+    """
+    from edl_tpu.utils.exceptions import EdlError
+    global _initialized
+    enable_compilation_cache()
+    try:
+        if _initialized:
+            jax.distributed.shutdown()
+            _initialized = False
+        if env.world_size > 1:
+            log.info("re-forming world: rank=%d/%d coordinator=%s",
+                     env.rank, env.world_size, env.coordinator)
+            jax.distributed.initialize(
+                coordinator_address=env.coordinator,
+                num_processes=env.world_size,
+                process_id=env.rank)
+            _initialized = True
+    except Exception as exc:  # noqa: BLE001 — typed for the reform
+        # machine's mesh-reform downgrade (stop-resume), never a crash
+        raise EdlError(f"mesh re-formation failed: {exc}") from exc
+    return env
+
+
 def is_initialized() -> bool:
     return _initialized
 

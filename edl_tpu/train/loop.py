@@ -104,6 +104,8 @@ class TrainLoop:
                  batch_axes: tuple[str, ...] | None = None,
                  place_state: Callable | None = None,
                  on_reform: Callable | None = None,
+                 reform_mesh: Callable | None = None,
+                 reform_config=None,
                  augment_fn: Callable | None = None):
         self.step_fn = step_fn
         self.state = state
@@ -167,6 +169,20 @@ class TrainLoop:
         # stop-resume. `on_reform(rank, world, cluster)` is the caller's
         # hook to re-derive data sharding for the new world.
         self.on_reform = on_reform
+        # Reform state machine hooks (collective/reform.py) — the
+        # device-world half of elasticity: `reform_mesh(rank, world,
+        # cluster)` returns the NEW mesh when the resize changes this
+        # process's device world (None = unchanged, the fast adoption
+        # path). The hook owns any `jax.distributed` re-initialization
+        # (parallel/distributed.reform_world) for true multi-host
+        # worlds; the loop then reshapes state through peer restore
+        # (disk fallback), re-jits under the compilation cache, and
+        # acks generation-fenced. A loop wired with this hook seals its
+        # live state at quiesce, so a reform loses zero progress.
+        self.reform_mesh = reform_mesh
+        self._reform_config = reform_config
+        self._reform_machine = None
+        self.last_reform: dict | None = None
         self._migration = None
         if self.ckpt is not None:
             try:
@@ -246,12 +262,19 @@ class TrainLoop:
         self.ckpt_stall_ms_total += (time.perf_counter() - t0) * 1e3
         self.ckpt_saves += 1
 
-    def _adopt(self, reform) -> None:
+    def _adopt(self, reform) -> str:
         """Adopt a resize in place: the new cluster still contains this
-        pod, so instead of dying into a stop-resume it re-derives its
-        data shard for the new (rank, world) and keeps the live state on
-        the devices. The measured gap (adoption -> first step of the new
-        generation) is the p2p resize downtime for survivors."""
+        pod, so instead of dying into a stop-resume it walks the reform
+        state machine (collective/reform.py). An unchanged device set
+        keeps the fast path (no seal, no restore — the 0.061 s survivor
+        gap); a device-world change (reform_mesh returns a new mesh)
+        pays quiesce-seal -> mesh-reform -> peer-restore (disk
+        fallback) -> re-jit, all inside the same OS process. Returns
+        "reform" (in place) or "stop" — the clean stop-resume downgrade
+        when a phase missed its deadline or failed past its fallback.
+        The measured gap (adoption -> first step of the new generation)
+        is the resize downtime for survivors either way."""
+        from edl_tpu.collective import reform as rf
         self._reform_t0 = time.perf_counter()
         if trace.enabled():
             from edl_tpu.collective.migration import resize_trace_ctx
@@ -263,8 +286,58 @@ class TrainLoop:
                        "rank": reform.rank, "world": reform.world_size,
                        "generation": reform.generation})
         log.info("live-reform: adopting cluster v%d rank=%d world=%d in "
-                 "place (no respawn, no restore)", reform.generation,
-                 reform.rank, reform.world_size)
+                 "place (no respawn)", reform.generation, reform.rank,
+                 reform.world_size)
+        machine = rf.ReformMachine(
+            reform.generation, self._reform_config,
+            trace_parent=(self._reform_span.context
+                          if self._reform_span is not None else None),
+            who=self._migration.pod_id)
+        self._reform_machine = machine
+        changed: dict = {}
+        try:
+            machine.run_phase("quiesce", self._reform_quiesce)
+            machine.run_phase(
+                "mesh-reform",
+                lambda dl: changed.update(
+                    mesh=self._reform_mesh_phase(reform, dl)))
+            if changed.get("mesh") is not None:
+                try:
+                    machine.run_phase("peer-restore",
+                                      self._reform_restore_peers)
+                    machine.restore = "peers"
+                except rf.ReformError as exc:
+                    if exc.downgrade != "disk":
+                        raise
+                    log.warning("reform peer-restore failed (%s) — "
+                                "disk-restore downgrade", exc)
+                    machine.run_phase("disk-restore",
+                                      self._reform_restore_disk)
+                    machine.restore = "disk"
+                self.status.world_size = mesh_lib.dp_size(self.mesh)
+            machine.result = rf.IN_PLACE
+        except rf.ReformError as exc:
+            # The defined downgrade: degrade to a CLEAN stop-resume.
+            # This trainer behaves exactly like a graceful SIGTERM stop
+            # — run() seals the live state, exits 143 and the migration
+            # shutdown lingers as a donor — and the launcher's adopt
+            # timeout respawns the world. A half-reformed survivor
+            # never acks: its generation is stale, so even a late ack
+            # attempt bounces off the epoch-doc fence.
+            machine.result = rf.STOP_RESUME
+            machine.error = str(exc)
+            self.last_reform = machine.finish()
+            self._reform_machine = None
+            log.warning("reform of generation %d degraded to "
+                        "stop-resume: %s", reform.generation, exc)
+            self.stop_reason = "reform-downgrade"
+            self._migration.stop_requested.set()
+            if self._reform_span is not None:
+                self._reform_span.end(result=machine.result,
+                                      error=machine.error)
+                self._reform_span = None
+            self._reform_t0 = None
+            return "stop"
         if self.on_reform is not None:
             self.on_reform(reform.rank, reform.world_size, reform.cluster)
         if self._util_publisher is not None:
@@ -274,6 +347,84 @@ class TrainLoop:
             self._util_publisher.generation = reform.generation
         self._migration.adopted(reform)
         self.reforms += 1
+        return "reform"
+
+    # -- reform phase executors (collective/reform.py ladder) --------------
+
+    def _reform_quiesce(self, deadline: float) -> None:
+        """Settle the device and (for device-world reforms) seal the
+        LIVE state: peer-restore then reassembles exactly this step on
+        the new mesh — a reform loses zero progress. Orchestration-only
+        adoptions (no reform_mesh hook) keep the cheap drain."""
+        if self._first_step_done:
+            jax.block_until_ready(self.state)
+        if self.ckpt is None:
+            return
+        if self.reform_mesh is not None:
+            self._save()
+        # TimeoutError here is the typed quiesce failure the machine
+        # downgrades on (a writer that cannot drain is a torn world)
+        self.ckpt.wait(timeout=max(0.1, deadline - time.monotonic()))
+        if self._migration is not None and self.reform_mesh is not None:
+            # make the fresh seal discoverable before peer-restore runs
+            self._migration.flush_advert()
+
+    def _reform_mesh_phase(self, reform, deadline: float):
+        """Apply the new topology. The hook owns any jax.distributed
+        re-initialization (reform_world) for true multi-host worlds and
+        returns the new mesh, or None when this process's device world
+        is unchanged (the fast adoption path)."""
+        del deadline  # cooperative: the hook gets the machine's budget
+        if self.reform_mesh is None:
+            return None
+        mesh = self.reform_mesh(reform.rank, reform.world_size,
+                                reform.cluster)
+        if mesh is None:
+            return None
+        log.info("reform: device world changed — new mesh %s",
+                 getattr(mesh, "shape", mesh))
+        self.mesh = mesh
+        return mesh
+
+    def _reform_target(self):
+        """Zero state pytree shaped like the live state, placed for the
+        NEW mesh — what the resharding planner assembles into."""
+        import numpy as np
+        zeros = jax.tree.map(
+            lambda a: np.zeros(a.shape, a.dtype)
+            if hasattr(a, "shape") else a, self.state)
+        return self.place_state(zeros) if self.place_state else zeros
+
+    def _reform_restore_peers(self, deadline: float) -> None:
+        del deadline  # restore_from_peers carries its own wire timeouts
+        # Sharded worlds merge every donor (versions are world-aligned
+        # by the save barrier); replicated per-pod states restore from
+        # their OWN just-sealed snapshot — per-pod version counters are
+        # not comparable, and each pod's state is its own lineage.
+        pods = None if self.config.ckpt_sharded \
+            else [self._migration.pod_id]
+        state, status, stats = self._migration.restore_from_peers(
+            self._reform_target(),
+            local_version=self.ckpt.latest_version()
+            if self.ckpt else None, pods=pods)
+        self.state = state
+        self.status = status
+        self.restore_source = "peers"
+        self.bytes_from_peers = int(stats["bytes_from_peers"])
+
+    def _reform_restore_disk(self, deadline: float) -> None:
+        del deadline
+        restored = self.ckpt.restore(self._reform_target()) \
+            if self.ckpt else None
+        if restored is None:
+            raise RuntimeError("no sealed local checkpoint to fall "
+                               "back to")
+        state, status = restored
+        if self.place_state is not None:
+            state = self.place_state(state)
+        self.state = state
+        self.status = status
+        self.restore_source = "disk"
 
     def ckpt_stats(self) -> dict:
         """Checkpoint-plane accounting for benchlog extras: loop-side
@@ -293,6 +444,11 @@ class TrainLoop:
         if self.last_reform_downtime_s is not None:
             out["reform_downtime_s"] = round(
                 self.last_reform_downtime_s, 4)
+        if self.last_reform is not None:
+            # the state machine's outcome (result / restore source /
+            # per-phase seconds) — what resize_bench's world axis and
+            # the --resize-reform demo audit read
+            out["reform"] = self.last_reform
         if self.ckpt is not None:
             out.update({f"ckpt_{k}": (round(v, 3)
                                       if isinstance(v, float) else v)
@@ -484,6 +640,7 @@ class TrainLoop:
         cfg = self.config
         window_start = time.perf_counter()
         window_samples = 0
+        rejit_s = 0.0  # set at the first dispatch of an adopted reform
         # Intra-epoch resume: a mid-epoch checkpoint recorded how many steps
         # of this (deterministically re-generated, seed-per-pass) epoch were
         # already applied — skip exactly that many batches without training
@@ -508,10 +665,17 @@ class TrainLoop:
                 reform = self._migration.poll_reform()
                 if reform is not None:
                     it.close()
-                    self._adopt(reform)
-                    return "reform"
+                    # "reform" re-enters the epoch in place; "stop" is
+                    # the machine's clean stop-resume downgrade
+                    return self._adopt(reform)
             self._profile_window()
+            t_dispatch = time.perf_counter()
             self.state, metrics = self.step_fn(self.state, batch)
+            if self._reform_t0 is not None:
+                # first dispatch of the adopted generation: the call
+                # wall covers trace + (cache-missing) compile — the
+                # re-jit phase of the reform ladder
+                rejit_s = time.perf_counter() - t_dispatch
             if not self._first_step_done:
                 # Downtime-accounting marker: the first step of THIS run
                 # (post-restore, post-compile) has really executed — the
@@ -544,10 +708,22 @@ class TrainLoop:
                 # First step of the adopted generation: force the
                 # dispatch so the measured gap covers real training
                 # resumption, not an async enqueue.
+                t_block = time.perf_counter()
                 jax.block_until_ready(self.state)
-                gap = time.perf_counter() - self._reform_t0
+                now = time.perf_counter()
+                gap = now - self._reform_t0
                 self._reform_t0 = None
                 self.last_reform_downtime_s = gap
+                reform_doc = None
+                if self._reform_machine is not None:
+                    # close the deferred ladder phases: the first
+                    # post-reform step IS re-jit (dispatch wall; a
+                    # compile-cache hit collapses it) + first-step
+                    machine = self._reform_machine
+                    self._reform_machine = None
+                    machine.note_deferred("re-jit", rejit_s)
+                    machine.note_deferred("first-step", now - t_block)
+                    reform_doc = self.last_reform = machine.finish()
                 log.info("reform-step-complete generation=%d "
                          "downtime_s=%.3f",
                          self._migration.generation, gap)
@@ -565,7 +741,12 @@ class TrainLoop:
                         self._util_publisher.resize_trace = \
                             self._reform_span.context
                     self._reform_span = None
-                self._migration.ack("adopted", downtime_s=round(gap, 4))
+                self._migration.ack(
+                    "adopted", downtime_s=round(gap, 4),
+                    bytes_from_peers=self.bytes_from_peers
+                    if reform_doc and reform_doc.get("restore") == "peers"
+                    else 0,
+                    reform=reform_doc)
             self.status.step += 1
             self.status.step_in_epoch = i + 1
             n = (batch_size_fn(batch) if batch_size_fn

@@ -85,6 +85,16 @@ ENV_VARS: dict[str, str] = {
                             "sealed snapshot",
     "EDL_TPU_ADOPT_TIMEOUT": "launcher wait for in-place adoption before "
                              "stop-resume",
+    # -- reform state machine (multi-host resize without restart) ----------
+    "EDL_TPU_REFORM_QUIESCE_S": "reform quiesce-phase deadline seconds "
+                                "(step/ckpt drain; stop-resume downgrade)",
+    "EDL_TPU_REFORM_MESH_S": "reform mesh-re-formation deadline seconds "
+                             "(stop-resume downgrade)",
+    "EDL_TPU_REFORM_RESTORE_S": "reform peer/disk restore deadline seconds "
+                                "(peer failure downgrades to disk)",
+    "EDL_TPU_REFORM_REJIT_S": "reform re-jit + first-step deadline seconds "
+                              "(advisory past dispatch; launcher adopt "
+                              "timeout is the hard bound)",
     # -- train loop / input plane ------------------------------------------
     "EDL_TPU_NUM_EPOCHS": "epochs to train",
     "EDL_TPU_LOG_EVERY": "log metrics every N steps",

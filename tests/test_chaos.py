@@ -337,3 +337,79 @@ def test_chaos_report_roundtrip():
     rep.breach("x")
     doc = rep.to_dict()
     assert doc["ok"] is False and doc["breaches"] == ["x"]
+
+
+# -- I6: reform ladders + the branch-anomaly pin (r20) ----------------------
+
+
+def test_audit_reform_paired_start_done_is_ok():
+    reports = {"pod0": [
+        {"kind": "reform_start", "generation": 3, "ts": 1.0},
+        {"kind": "restore", "version": 2, "digest": "d", "ts": 1.1},
+        {"kind": "reform_done", "generation": 3, "result": "in-place",
+         "restore": "peers", "ts": 1.2},
+        {"kind": "reform_start", "generation": 4, "ts": 2.0},
+        {"kind": "reform_done", "generation": 4,
+         "result": "stop-resume", "ts": 2.3},
+        {"kind": "registered", "rank": 0, "ts": 2.5},
+    ]}
+    rep = _auditor(worker_reports=reports).audit()
+    assert rep.ok, rep.breaches
+    assert rep.stats["reforms_started"] == 2
+    assert rep.stats["reforms_completed"] == 2
+    assert rep.stats["reform_downgrades"] == 1
+
+
+def test_audit_reform_wedge_is_a_breach():
+    # the worker moved on (published util, consumed watches) with the
+    # ladder still open: neither completed nor degraded = torn world
+    reports = {"pod0": [
+        {"kind": "reform_start", "generation": 3, "ts": 1.0},
+        {"kind": "watch", "revisions": [9], "ts": 1.5},
+    ]}
+    rep = _auditor(worker_reports=reports).audit()
+    assert any("I6" in b and "wedged" in b or "torn" in b
+               for b in rep.breaches), rep.breaches
+
+
+def test_audit_reform_death_midladder_is_not_a_wedge():
+    # a SIGKILL mid-ladder shows as a fresh incarnation ("started"):
+    # that is a process fault the respawn covers, not an I6 breach
+    reports = {"pod0": [
+        {"kind": "reform_start", "generation": 3, "ts": 1.0},
+        {"kind": "started", "pod_id": "pod0-1", "ts": 2.0},
+        {"kind": "registered", "rank": 0, "ts": 2.2},
+    ]}
+    rep = _auditor(worker_reports=reports).audit()
+    assert rep.ok, rep.breaches
+    assert rep.stats["reforms_died_midladder"] == 1
+
+
+def test_audit_reform_unknown_result_is_a_breach():
+    reports = {"pod0": [
+        {"kind": "reform_start", "generation": 3, "ts": 1.0},
+        {"kind": "reform_done", "generation": 3, "result": "wedged?",
+         "ts": 1.2},
+    ]}
+    rep = _auditor(worker_reports=reports).audit()
+    assert any("I6" in b and "unknown result" in b for b in rep.breaches)
+
+
+def test_audit_branch_anomalies_pinned_to_zero():
+    # commit-gated fan-out (r20) turned the documented r18 stat into a
+    # hard invariant: any observed uncommitted suffix fails the soak
+    probe = {"acked": {}, "seen": {}, "duplicates": 0,
+             "final_values": [], "branch_anomalies": 1}
+    rep = _auditor(probe=probe).audit()
+    assert any("branch anomalies" in b for b in rep.breaches)
+    assert rep.stats["branch_anomalies"] == 1
+
+
+def test_schedule_reform_class_compounds_a_resize():
+    sched = ChaosSchedule.generate(5, 3 * len(FAULT_CLASSES), pods=2)
+    reforms = [e for e in sched if e.fault == "reform"]
+    assert reforms, "reform class missing from a full-mix schedule"
+    for e in reforms:
+        assert e.params["sub"] in ("kill-donor", "pause-survivor",
+                                   "partition-store")
+        assert e.target == "job"

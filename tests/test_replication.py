@@ -319,3 +319,158 @@ def test_asymmetric_partition_writes_bounce_then_client_fails_over(group):
     old.node.set_partition(None)  # heal: deposed leader snapshot-rejoins
     assert _wait(lambda: old.node.role() == "follower", timeout=15.0)
     client.close()
+
+
+# -- commit-gated watch fan-out (the r18 branch-anomaly regression) ---------
+
+
+def test_fanout_gate_reuse_of_revisions_is_invisible():
+    """The deterministic half of the r18 anomaly drill: a gated store
+    (standing in for a doomed leader) applies a suffix that never
+    commits; its watchers must see NOTHING of it — not at apply time,
+    not as a resume-anchor advance, and not after the new reign reuses
+    those revision numbers with different values (snapshot install)."""
+    from edl_tpu.coord.store import InMemStore
+
+    store = InMemStore()
+    store.set_fanout_gate(True)
+    watch = store.watch("/j/")
+    rev1 = store.put("/j/a", "committed")
+    store.release_fanout(rev1)
+    batch = watch.get(timeout=2.0)
+    assert batch is not None and batch.events[0].value == "committed"
+
+    # the doomed suffix: applied locally, never majority-committed
+    store.put("/j/k", "doomed-1")
+    store.put("/j/k", "doomed-2")
+    assert watch.get(timeout=0.2) is None, \
+        "uncommitted suffix leaked to a watcher"
+    # the resume anchor must NOT advance past the commit gate — a
+    # client resuming from it on the new reign would skip the reused
+    # revisions entirely
+    assert watch.progress_revision() == rev1
+
+    # the new reign: same revision numbers, different (committed) data
+    reign = InMemStore()
+    reign.apply_put("/j/a", "committed", rev1)
+    reign.apply_put("/j/k", "good", rev1 + 1)
+    store.install_snapshot(reign.snapshot_state())
+    batch = watch.get(timeout=2.0)
+    assert batch is not None and batch.compacted, \
+        "snapshot rejoin must force an explicit resync"
+    assert store.get("/j/k").value == "good"
+    # nothing pending survived the snapshot: later releases are no-ops
+    store.release_fanout(10_000)
+    assert watch.get(timeout=0.2) is None
+    watch.cancel()
+
+
+def test_fanout_gate_late_watcher_gets_tail_exactly_once():
+    """A watcher subscribing with start_revision while a suffix is
+    buffered replays only the committed prefix; the tail arrives
+    exactly once when the commit gate advances over it."""
+    from edl_tpu.coord.store import InMemStore
+
+    store = InMemStore()
+    store.set_fanout_gate(True)
+    r1 = store.put("/j/a", "1")
+    store.release_fanout(r1)
+    r2 = store.put("/j/b", "2")   # buffered behind the gate
+    watch = store.watch("/j/", start_revision=0)
+    batch = watch.get(timeout=2.0)
+    assert [e.revision for e in batch.events] == [r1]
+    assert watch.get(timeout=0.1) is None
+    store.release_fanout(r2)
+    batch = watch.get(timeout=2.0)
+    assert [e.revision for e in batch.events] == [r2]
+    assert watch.get(timeout=0.1) is None  # exactly once
+    watch.cancel()
+
+
+def test_fanout_gate_resume_anchor_never_redelivers_pending():
+    """The failover-duplicate regression: a watcher that already HAS
+    revision R (it resumes with start_revision=R on a replica whose
+    commit gate is still behind R) must not be handed R again when the
+    gate advances over the replica's pending tail."""
+    from edl_tpu.coord.store import InMemStore
+
+    follower = InMemStore()
+    follower.set_fanout_gate(True)
+    follower.apply_put("/j/a", "1", 1)
+    follower.release_fanout(1)
+    # rev 2 applied but its commit not yet learned (pending)
+    follower.apply_put("/j/b", "2", 2)
+    # the client already consumed rev 2 from the dead leader: resume
+    watch = follower.watch("/j/", start_revision=2)
+    follower.release_fanout(2)
+    assert watch.get(timeout=0.2) is None, \
+        "resume anchor re-delivered by the commit-gate release"
+    # but an event genuinely past the anchor still flows
+    follower.apply_put("/j/c", "3", 3)
+    follower.release_fanout(3)
+    batch = watch.get(timeout=2.0)
+    assert [e.revision for e in batch.events] == [3]
+    watch.cancel()
+
+
+def test_watch_on_doomed_leader_never_sees_its_suffix(group):
+    """End-to-end over real sockets: a watcher pinned to a leader that
+    gets partitioned from quorum must never be shown the write the
+    partition catches (it may commit later OR be discarded — either
+    way nothing is delivered until the outcome is decided), and after
+    the new reign + snapshot rejoin the watcher resyncs to the
+    committed branch only."""
+    old = group.wait_leader()
+    pinned = StoreClient(old.endpoint, timeout=2.0, connect_retries=2,
+                         retry_interval=0.05)
+    wclient = StoreClient(old.endpoint, timeout=2.0, connect_retries=2,
+                          retry_interval=0.05)
+    watch = wclient.watch("/branch/", start_revision=0)
+    pinned.put("/branch/pre", "committed")
+
+    def drain(seconds):
+        got = []
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            batch = watch.get(timeout=0.2)
+            if batch is not None:
+                got.append(batch)
+        return got
+
+    pre = [ev for b in drain(2.0) for ev in b.events]
+    assert any(ev.value == "committed" for ev in pre)
+
+    old.node.set_partition(True)
+    with pytest.raises(EdlStoreError):
+        pinned.put("/branch/k", "doomed")
+    # whatever the old leader applied locally is behind the commit
+    # gate: its watcher must see NO events for it
+    assert not [ev for b in drain(1.5) for ev in b.events], \
+        "watcher on the doomed leader saw its uncommitted suffix"
+
+    others = [s for s in group.servers if s is not old]
+    assert _wait(lambda: any(s.node.is_leader() for s in others),
+                 timeout=15.0)
+    ha = StoreClient(",".join(s.endpoint for s in others), timeout=3.0)
+    ha.put("/branch/k", "good")  # the committed branch (revisions may
+    # collide with the doomed suffix's — that is the point)
+
+    old.node.set_partition(None)
+    assert _wait(lambda: old.node.role() == "follower", timeout=15.0)
+    assert _wait(lambda: old.node.store.get("/branch/k") is not None
+                 and old.node.store.get("/branch/k").value == "good",
+                 timeout=15.0)
+    # the watcher either got an explicit compacted resync (snapshot
+    # rejoin) or nothing — but NEVER a doomed value, and never the
+    # same revision with two different values
+    seen: dict[int, str] = {}
+    for b in drain(3.0):
+        for ev in b.events:
+            assert ev.value != "doomed"
+            assert seen.get(ev.revision, ev.value) == ev.value, \
+                "same revision delivered with two different values"
+            seen[ev.revision] = ev.value
+    watch.cancel()
+    wclient.close()
+    pinned.close()
+    ha.close()

@@ -24,6 +24,25 @@ With ``EDL_TPU_TRACE`` set (obs plane), each p2p row also gets a
 phase-breakdown column derived from the restore's spans — how much of
 the restore term was chunk transfer (``migrate.fetch``) vs planner/
 assembly, and how many chunks crossed the wire.
+
+``--worlds`` adds the MULTI-PROCESS world axis: real subprocess worlds
+(launcher pods under a JobServer) driven through scripted grow/shrink
+resizes, one row per (direction, transport):
+
+- ``stop-resume``     — a restarted process's full price: respawn +
+                        re-import + re-jit + peer/disk restore (the
+                        grown pod of the reform demo);
+- ``p2p-adopt``       — a survivor whose device set is unchanged
+                        adopts in place (``elastic_demo --resize-p2p``);
+- ``in-place-reform`` — a survivor whose device world CHANGED walks
+                        the reform state machine (quiesce-seal ->
+                        mesh-reform -> peer-restore -> re-jit) without
+                        leaving its process (``--resize-reform``);
+                        warm = shape already compiled, cold = first
+                        sight of the shape (exactly one compile).
+
+Each demo self-audits and this tool refuses to print rows from a
+failed run. Sequential by design — the bench host has one core.
 """
 
 from __future__ import annotations
@@ -192,6 +211,63 @@ def sweep_size(size_mb: float, src_n: int, directions, trials: int):
     return rows
 
 
+def _run_demo(flag: str) -> dict | None:
+    """Run one elastic_demo mode in a subprocess; parsed summary or
+    None on failure (the demos self-audit and exit nonzero)."""
+    import re
+    import subprocess
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)           # each demo sets its own world
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    tag = {"--resize-p2p": "p2p_summary",
+           "--resize-reform": "reform_summary"}[flag]
+    proc = subprocess.run(
+        [sys.executable, "-m", "edl_tpu.examples.elastic_demo", flag],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    m = re.search(tag + r"=(\{.*\})", proc.stdout)
+    if not m or proc.returncode != 0:
+        print(f"{flag} demo failed (rc={proc.returncode}) — rows "
+              "omitted", file=sys.stderr)
+        print(proc.stdout[-1500:], file=sys.stderr)
+        return None
+    return json.loads(m.group(1))
+
+
+def sweep_worlds() -> None:
+    """The multi-process world axis: grow/shrink across real subprocess
+    worlds, downtime per transport (see module docstring)."""
+    print("\nmulti-process world axis: measured resize downtime per "
+          "transport\n(real launcher-pod subprocess worlds; each demo "
+          "self-audits)\n")
+    print("| direction | transport | survivor restarts | downtime s "
+          "| notes |")
+    print("|-----------|-----------|-------------------|-----------:"
+          "|-------|")
+    p2p = _run_demo("--resize-p2p")
+    reform = _run_demo("--resize-reform")
+    if p2p is not None:
+        gaps = p2p.get("adoption_gaps_s") or []
+        for direction, gap in zip(("shrink", "grow"), gaps):
+            print(f"| {direction} | p2p-adopt | 0 | {gap:9.4f} "
+                  "| device set unchanged |")
+    if reform is not None:
+        gaps = reform.get("reform_gaps_s") or []
+        warm = reform.get("elastic_downtime_multihost_s")
+        for gap in gaps:
+            label = "warm (cached shape)" if gap == warm \
+                else "cold (one compile)"
+            print(f"| shrink/grow | in-place-reform | 0 | {gap:9.4f} "
+                  f"| {label}; restore "
+                  f"{(reform.get('last_reform') or {}).get('restore')} "
+                  "|")
+        respawn = reform.get("respawn_downtime_s")
+        if respawn is not None:
+            print(f"| grow | stop-resume | 1 | {respawn:9.4f} "
+                  "| respawn + re-import + re-jit + peer restore |")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tools/resize_bench.py")
     parser.add_argument("--sizes-mb", type=float, nargs="+",
@@ -200,7 +276,16 @@ def main(argv=None) -> int:
     parser.add_argument("--grow-devices", type=int, default=8)
     parser.add_argument("--shrink-devices", type=int, default=2)
     parser.add_argument("--trials", type=int, default=3)
+    parser.add_argument("--worlds", action="store_true",
+                        help="also run the multi-process world axis "
+                             "(subprocess worlds; ~3-4 min)")
+    parser.add_argument("--worlds-only", action="store_true",
+                        help="skip the single-host sweep")
     args = parser.parse_args(argv)
+
+    if args.worlds_only:
+        sweep_worlds()
+        return 0
 
     import jax
     n_dev = len(jax.devices())
@@ -225,6 +310,8 @@ def main(argv=None) -> int:
             size_mb, path, direction, mesh, secs, nbytes, phases = row
             print(f"| {size_mb:.0f}MB | {path} | {direction} | {mesh} "
                   f"| {secs:9.4f} | {nbytes / 2**20:8.1f} | {phases} |")
+    if args.worlds:
+        sweep_worlds()
     return 0
 
 
