@@ -182,6 +182,20 @@ def main(argv=None) -> int:
     parser.add_argument("--warmup-epochs", type=int, default=5)
     parser.add_argument("--momentum", type=float, default=0.9)
     parser.add_argument("--weight-decay", type=float, default=1e-4)
+    parser.add_argument("--dcn-compress", choices=("off", "topk", "int8"),
+                        default=None,
+                        help="cross-slice gradient wire format (default "
+                             "$EDL_TPU_DCN_COMPRESS, else off): topk "
+                             "ships values+indices, int8 one scale per "
+                             "chip — both with error-feedback residuals "
+                             "behind the loss-parity gate "
+                             "(doc/design_comm.md)")
+    parser.add_argument("--comm-bucket-mb", type=float, default=None,
+                        help="bucket the gradient tree into N-MiB "
+                             "reduction groups so late-backward buckets "
+                             "overlap earlier buckets' communication "
+                             "(default $EDL_TPU_COMM_BUCKET_MB, else 0 "
+                             "= XLA's single fused reduction)")
     parser.add_argument("--dgc-sparsity", type=float, default=0.0,
                         help="deep gradient compression: fraction of "
                              "gradient entries dropped (0 = off; the "
@@ -341,6 +355,23 @@ def main(argv=None) -> int:
     # dp's major dimension crosses DCN, flat dp otherwise
     mesh = distributed.make_mesh_from_env(mesh_lib.MeshSpec({"dp": -1}),
                                           env)
+    # DCN-aware gradient path: CLI > env (LoopConfig binding) > off.
+    # A compressed wire implies bucketing (default 4 MiB target).
+    dcn_compress = (args.dcn_compress if args.dcn_compress is not None
+                    else loop_cfg.dcn_compress)
+    comm_bucket_mb = (args.comm_bucket_mb
+                      if args.comm_bucket_mb is not None
+                      else loop_cfg.comm_bucket_mb)
+    comm_cfg = None
+    if dcn_compress != "off" or comm_bucket_mb > 0:
+        if args.teachers:
+            raise SystemExit(
+                "--dcn-compress/--comm-bucket-mb are not supported "
+                "with --teachers (the distill steps carry their own "
+                "jit; the dp gradient wire is the student-only path)")
+        from edl_tpu.train.comm import CommConfig
+        comm_cfg = CommConfig(bucket_mb=comm_bucket_mb or 4.0,
+                              compress=dcn_compress)
     data_sharding = mesh_lib.data_sharding(mesh)
     normalize = None
     if args.data_format == "jpeg":
@@ -462,7 +493,13 @@ def main(argv=None) -> int:
             # with device augmentation the augment op normalizes (one
             # fused uint8->float pass after crop/flip); the step must
             # not normalize twice
-            normalize=None if augment_device else normalize)
+            normalize=None if augment_device else normalize,
+            comm=comm_cfg, mesh=mesh,
+            topology=distributed.slice_topology(env))
+        if comm_cfg is not None:
+            log.info("dcn-aware gradient path: bucket=%.1fMiB "
+                     "compress=%s", comm_cfg.bucket_mb,
+                     comm_cfg.compress)
     eval_step = make_eval_step(normalize=normalize)
     augment = None
     if augment_device:
@@ -569,6 +606,8 @@ def main(argv=None) -> int:
         if distill_reader is not None:
             distill_reader.close()
     blog.extra(**loop.ckpt_stats())  # save-stall / restore accounting
+    if comm_cfg is not None:
+        blog.extra(**step.stats())  # bucket plan + DCN wire accounting
     if rank == 0 and args.benchmark_log:
         blog.write(args.benchmark_log, rank)
     final = blog.finalize().get("final", {})

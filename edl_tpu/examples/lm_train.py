@@ -93,6 +93,20 @@ def main(argv=None) -> int:
                         help="streamed-vocab CE: never materializes the "
                              "(B,S,V) logits (ops/fused_xent.py) — use "
                              "when the vocab is large")
+    parser.add_argument("--dcn-compress", choices=("off", "topk", "int8"),
+                        default=None,
+                        help="cross-slice gradient wire format (default "
+                             "$EDL_TPU_DCN_COMPRESS, else off): topk "
+                             "ships values+indices, int8 one scale per "
+                             "chip — both with error-feedback residuals "
+                             "behind the loss-parity gate "
+                             "(doc/design_comm.md)")
+    parser.add_argument("--comm-bucket-mb", type=float, default=None,
+                        help="bucket the gradient tree into N-MiB "
+                             "reduction groups so late-backward buckets "
+                             "overlap earlier buckets' communication "
+                             "(default $EDL_TPU_COMM_BUCKET_MB, else 0 "
+                             "= XLA's single fused reduction)")
     parser.add_argument("--mesh", choices=("dp", "fsdp", "sp"),
                         default="dp",
                         help="dp: data parallel; fsdp: params sharded; "
@@ -181,11 +195,36 @@ def main(argv=None) -> int:
     # single-slice worlds get the flat mesh as before
     mesh = distributed.make_mesh_from_env(mesh_lib.MeshSpec({kind: -1}),
                                           env)
+    # DCN-aware gradient path: CLI > env (LoopConfig binding) > off.
+    # A compressed wire implies bucketing (default 4 MiB target).
+    dcn_compress = (args.dcn_compress if args.dcn_compress is not None
+                    else loop_cfg.dcn_compress)
+    comm_bucket_mb = (args.comm_bucket_mb
+                      if args.comm_bucket_mb is not None
+                      else loop_cfg.comm_bucket_mb)
+    comm_cfg = None
+    if dcn_compress != "off" or comm_bucket_mb > 0:
+        if kind != "dp":
+            raise SystemExit(
+                f"--dcn-compress/--comm-bucket-mb own the dp gradient "
+                f"reduction; --mesh {kind} keeps the XLA-partitioned "
+                "step (fsdp/tp collectives are slice-local already)")
+        if args.fp16:
+            raise SystemExit("--dcn-compress/--comm-bucket-mb are not "
+                             "supported with --fp16 (the manual path "
+                             "owns the backward's reduction)")
+        from edl_tpu.train.comm import CommConfig
+        comm_cfg = CommConfig(bucket_mb=comm_bucket_mb or 4.0,
+                              compress=dcn_compress)
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,
         dtype=(jnp.float16 if args.fp16
-               else jnp.bfloat16 if args.bf16 else jnp.float32), mesh=mesh)
+               else jnp.bfloat16 if args.bf16 else jnp.float32),
+        # the comm step's manual region is mesh-free: sharding
+        # constraints / nested shard_maps would clash with the manual
+        # dp axis — each shard computes exactly one chip's backward
+        mesh=None if comm_cfg is not None else mesh)
     model = Transformer(cfg)
 
     source = FileSource(files)
@@ -220,6 +259,12 @@ def main(argv=None) -> int:
         def step(state, batch):
             state, metrics, ls_box[0] = raw_step(state, batch, ls_box[0])
             return state, metrics
+    elif comm_cfg is not None:
+        step = make_train_step(loss, donate=True, comm=comm_cfg,
+                               mesh=mesh,
+                               topology=distributed.slice_topology(env))
+        log.info("dcn-aware gradient path: bucket=%.1fMiB compress=%s",
+                 comm_cfg.bucket_mb, comm_cfg.compress)
     else:
         step = make_train_step(loss, donate=True)
     log.info("world=%d rank=%d devices=%d params=%s steps/epoch=%d",
@@ -268,6 +313,8 @@ def main(argv=None) -> int:
     data_fn.close = loader.close  # TrainLoop tears down the mp workers
     status = loop.run(data_fn)
     blog.extra(**loop.ckpt_stats())  # save-stall / restore accounting
+    if comm_cfg is not None:
+        blog.extra(**step.stats())  # bucket plan + DCN wire accounting
     if rank == 0 and args.benchmark_log:
         blog.write(args.benchmark_log, rank)
     final = blog.finalize().get("final", {})

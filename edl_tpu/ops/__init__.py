@@ -4,8 +4,9 @@ from edl_tpu.ops.augment import (AUGMENT_SEED_KEY, apply_crop,
                                  normalize_image)
 from edl_tpu.ops.flash_attention import flash_attention
 from edl_tpu.ops.fused_xent import streamed_lm_xent
+from edl_tpu.ops.pack import pack_int8, unpack_int8
 
 __all__ = ["AUGMENT_SEED_KEY", "apply_crop", "apply_flip_lr",
            "flash_attention", "host_crop_flip_decisions",
            "make_device_augment", "mixup", "normalize_image",
-           "streamed_lm_xent"]
+           "pack_int8", "streamed_lm_xent", "unpack_int8"]

@@ -1,0 +1,113 @@
+"""int8 gradient-bucket pack/unpack for the compressed DCN leg.
+
+One fused pass over a flat gradient shard: abs-max -> symmetric scale ->
+round-to-nearest int8. On TPU this is a single-VMEM-resident Pallas
+kernel (the shard is a comm bucket slice, a few MiB — well under the
+~16 MiB VMEM bound; the abs-max reduction and the quantized store share
+one read of HBM instead of XLA's two). Everywhere else the plain-XLA
+expression is used — interpret-mode Pallas is orders of magnitude
+slower and this sits in the hot step (same split as
+ops/flash_attention.py; `force_pallas_interpret()` is the test hook
+that runs the kernel path on CPU to pin equivalence).
+
+The wire format (what `train/comm.py` ships over DCN): int8 payload of
+the shard + ONE fp32 scale. Symmetric around zero — no zero-point, so
+dequantize is a single multiply and a zero gradient round-trips to
+exactly zero. Error feedback upstream (comm._cross_int8) carries the
+rounding error, so the format's bias is bounded by scale/2 per element
+per step and reclaimed on later steps.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_QMAX = 127.0
+_LANE = 128         # TPU lane width: kernel operands reshape to (-1, 128)
+_FORCE_INTERPRET = False
+
+
+def force_pallas_interpret():
+    """Test hook: route pack/unpack through the Pallas kernels in
+    interpret mode on non-TPU backends (equivalence pinning only —
+    interpret mode is far too slow for the hot step)."""
+    global _FORCE_INTERPRET
+    _FORCE_INTERPRET = True
+
+
+def _use_pallas() -> bool:
+    return _FORCE_INTERPRET or jax.default_backend() == "tpu"
+
+
+# -- plain-XLA reference (the non-TPU hot path) ------------------------------
+
+
+def _scale_of(x: jnp.ndarray) -> jnp.ndarray:
+    amax = jnp.max(jnp.abs(x))
+    # all-zero shard: scale 1.0 so q == 0 and dequantize is exact
+    return jnp.where(amax > 0, amax / _QMAX, 1.0).astype(jnp.float32)
+
+
+def _pack_xla(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    scale = _scale_of(x)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -_QMAX, _QMAX)
+    return q.astype(jnp.int8), scale
+
+
+# -- Pallas kernel -----------------------------------------------------------
+
+
+def _pack_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:].astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x))
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0)
+    s_ref[0, 0] = scale
+    q_ref[:] = jnp.clip(jnp.round(x / scale),
+                        -_QMAX, _QMAX).astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pack_pallas(x2d: jnp.ndarray, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    q, s = pl.pallas_call(
+        _pack_kernel,
+        out_shape=(jax.ShapeDtypeStruct(x2d.shape, jnp.int8),
+                   jax.ShapeDtypeStruct((1, 1), jnp.float32)),
+        interpret=interpret,
+    )(x2d)
+    return q, s[0, 0]
+
+
+# -- public API --------------------------------------------------------------
+
+
+def pack_int8(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Flat float shard -> (int8 payload of the same shape, fp32 scale).
+
+    Traceable (used inside jit/shard_map). Kernel path on TPU; the
+    ragged tail past a multiple of the 128-lane width is padded with
+    zeros for the kernel and sliced back off (zeros never win the
+    abs-max, so padding cannot perturb the scale).
+    """
+    if not _use_pallas():
+        return _pack_xla(x)
+    n = x.shape[0] if x.ndim == 1 else int(np.prod(x.shape))
+    flat = x.reshape(-1)
+    pad = (-n) % _LANE
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    q2d, scale = _pack_pallas(flat.reshape(-1, _LANE),
+                              interpret=jax.default_backend() != "tpu")
+    q = q2d.reshape(-1)[:n].reshape(x.shape)
+    return q, scale
+
+
+def unpack_int8(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`pack_int8` (one multiply — no kernel needed;
+    XLA fuses it into the consumer)."""
+    return q.astype(jnp.float32) * scale.astype(jnp.float32)

@@ -231,20 +231,24 @@ def make_hybrid_mesh(spec: MeshSpec | None = None,
     return Mesh(arr, tuple(names))
 
 
-def make_mesh(spec: MeshSpec | None = None, n_devices: int | None = None,
-              devices: list | None = None) -> Mesh:
-    """Build a Mesh over the first n_devices (elastic prefix of the world)."""
-    spec = spec or MeshSpec()
-    if devices is None:
-        devices = jax.devices()
-    if n_devices is not None:
-        if n_devices > len(devices):
-            raise ValueError(
-                f"want {n_devices} devices, have {len(devices)}")
-        devices = devices[:n_devices]
-    sizes = spec.resolve(len(devices))
-    arr = np.array(devices).reshape(tuple(sizes.values()))
-    return Mesh(arr, tuple(sizes.keys()))
+def dp_comm_groups(n_slices: int, chips_per_slice: int
+                   ) -> tuple[list[list[int]], list[list[int]]]:
+    """(intra-slice, cross-slice) ``axis_index_groups`` over a
+    slice-major dp axis.
+
+    The manual-collective complement of `make_hybrid_mesh`: its device
+    order makes dp index ``d = s * chips_per_slice + c``, so the
+    intra-slice groups (dense ICI reduce-scatter / all-gather legs)
+    are the C-contiguous chunks and the cross-slice groups (the DCN
+    leg) are the stride-C columns. Static python lists — usable as
+    ``axis_index_groups`` inside shard_map (train/comm.py's
+    hierarchical reduction).
+    """
+    intra = [[s * chips_per_slice + c for c in range(chips_per_slice)]
+             for s in range(n_slices)]
+    cross = [[s * chips_per_slice + c for s in range(n_slices)]
+             for c in range(chips_per_slice)]
+    return intra, cross
 
 
 def data_sharding(mesh: Mesh, batch_axes: tuple[str, ...] | None = None

@@ -119,6 +119,15 @@ def init_sharded(init_fn, mesh: Mesh,
                    out_shardings=shardings)()
 
 
+def dp_row_sharding(mesh: Mesh) -> NamedSharding:
+    """One distinct row per dp position: ``(W, ...)`` arrays laid out
+    ``P('dp')``. The placement of the comm plane's per-chip
+    error-feedback residuals (train/comm.py) — each chip owns exactly
+    its own row, so a shard_map over dp sees its local ``(1, ...)``
+    block and no residual ever crosses a link."""
+    return NamedSharding(mesh, P("dp"))
+
+
 def constrain(x: jax.Array, logical: Sequence[str | None],
               mesh: Mesh | None = None,
               rules: Sequence[tuple[str, Any]] = DEFAULT_RULES) -> jax.Array:

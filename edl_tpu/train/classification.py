@@ -79,13 +79,16 @@ def make_classification_step(num_classes: int, *, smoothing: float = 0.0,
                              mixup_alpha: float = 0.0, seed: int = 0,
                              weight_decay_in_loss: float = 0.0,
                              normalize: str | None = None,
-                             donate: bool = True) -> Callable:
+                             donate: bool = True, comm=None, mesh=None,
+                             topology=None) -> Callable:
     """Jitted (state, batch)->(state, metrics) for {'image','label'} batches.
 
     Handles flax BN mutable batch_stats; mixup/smoothing optional. L2 can be
     added here (reference uses optimizer regularizer; prefer optax wd).
     `normalize` runs on-device pixel normalization (see `normalize_image`)
     so uint8 batches off the JPEG plane train directly.
+    `comm`/`mesh`/`topology` route the gradient reduction through the
+    manual DCN-aware bucketed path (train/comm.py) — see make_train_step.
     """
 
     def loss_fn(state: TrainState, params: Any, batch: dict):
@@ -113,7 +116,8 @@ def make_classification_step(num_classes: int, *, smoothing: float = 0.0,
             aux["batch_stats"] = new_stats
         return loss, aux
 
-    return make_train_step(loss_fn, donate=donate)
+    return make_train_step(loss_fn, donate=donate, comm=comm, mesh=mesh,
+                           topology=topology)
 
 
 def _make_kd_step(kd_loss: Callable, num_classes: int, *,

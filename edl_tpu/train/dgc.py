@@ -145,7 +145,8 @@ def dgc(sparsity: float = 0.99, momentum: float = 0.9,
     return optax.GradientTransformation(init_fn, update_fn)
 
 
-def sparse_psum(tree, axis_name: str, keep_frac: float = 0.01):
+def sparse_psum(tree, axis_name: str, keep_frac: float = 0.01,
+                axis_index_groups=None):
     """Cross-worker gradient sum transferring only top-k per worker.
 
     For use INSIDE `shard_map` (where the author owns the collective):
@@ -157,20 +158,32 @@ def sparse_psum(tree, axis_name: str, keep_frac: float = 0.01):
     convergence behavior keep them in a local residual — the `dgc`
     transform's bookkeeping — and re-contribute later).
 
+    ``axis_index_groups`` scopes the reduction to subgroups of the axis
+    exactly as in `lax.psum` — how a hierarchical decomposition keeps
+    this wire on the slow cross-slice leg only
+    (`mesh.dp_comm_groups`). The bucketed gradient path
+    (`train/comm.py`) is this wire PLUS persistent error-feedback
+    residuals and size-bucketed scheduling; use that for whole-step
+    training, this for one-off tree reductions.
+
     Leaves with < 64 entries fall back to a dense `lax.psum`.
-    Returns a tree of dense summed gradients, identical across workers.
+    Returns a tree of dense summed gradients, identical across workers
+    (within each group, when grouped).
     """
     def leaf(v):
         n = v.size
         if n < 64 or keep_frac >= 1.0:
-            return lax.psum(v, axis_name)
+            return lax.psum(v, axis_name,
+                            axis_index_groups=axis_index_groups)
         k = max(1, int(round(n * keep_frac)))
         flat = v.reshape(-1)
         _, idx = jax.lax.top_k(jnp.abs(flat), k)
         vals = flat[idx]  # signed values at the top-|.| positions
-        # (world, k) after gather — the ONLY cross-worker bytes
-        all_vals = lax.all_gather(vals, axis_name)
-        all_idx = lax.all_gather(idx, axis_name)
+        # (group, k) after gather — the ONLY cross-worker bytes
+        all_vals = lax.all_gather(vals, axis_name,
+                                  axis_index_groups=axis_index_groups)
+        all_idx = lax.all_gather(idx, axis_name,
+                                 axis_index_groups=axis_index_groups)
         dense = jnp.zeros_like(flat).at[all_idx.reshape(-1)].add(
             all_vals.reshape(-1))
         return dense.reshape(v.shape)

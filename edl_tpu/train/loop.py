@@ -83,6 +83,14 @@ class LoopConfig:
     # (imagenet_train --augment-device); 0 = host transforms, the
     # unchanged fallback path.
     augment_device: bool = field(False, env="EDL_TPU_AUGMENT_DEVICE")
+    # DCN-aware gradient path (train/comm.py): bucket the gradient
+    # tree into comm_bucket_mb-MiB reduction groups (0 = keep the
+    # XLA-partitioned single-graph reduction) and optionally compress
+    # the cross-slice DCN leg (off|topk|int8, error-feedback residuals,
+    # loss-parity gated). Entrypoints read these to build the manual
+    # step (--dcn-compress / --comm-bucket-mb override).
+    comm_bucket_mb: float = field(0.0, env="EDL_TPU_COMM_BUCKET_MB")
+    dcn_compress: str = field("off", env="EDL_TPU_DCN_COMPRESS")
 
 
 class TrainLoop:

@@ -17,7 +17,8 @@ LossFn = Callable[..., tuple[jax.Array, dict]]
 
 
 def make_train_step(loss_fn: LossFn, donate: bool = True,
-                    loss_scale: bool = False) -> Callable:
+                    loss_scale: bool = False, comm=None, mesh=None,
+                    topology=None) -> Callable:
     """Build a jitted step from loss_fn(state, params, batch)->(loss, aux).
 
     If the model has batch_stats (BN), loss_fn should return aux containing
@@ -28,7 +29,26 @@ def make_train_step(loss_fn: LossFn, donate: bool = True,
     train_with_fleet.py:68-72,318-321): the step signature becomes
     `step(state, batch, ls) -> (state, metrics, ls)` and metrics gain
     'loss_scale'/'finite'. Unneeded for bf16 (the TPU default).
+
+    `comm` (a train/comm.CommConfig, with `mesh` and optionally the
+    slice `topology`) swaps the XLA-partitioned gradient reduction for
+    the manual DCN-aware path: size-bucketed, hierarchically decomposed
+    (ICI reduce-scatter -> cross-slice leg -> ICI all-gather) and
+    optionally compressed dp reductions with a loss-parity gate
+    (doc/design_comm.md). dp-only meshes; bucketed-dense is bitwise
+    with the plain jit path on flat worlds.
     """
+    if comm is not None:
+        if loss_scale:
+            raise ValueError(
+                "comm= and loss_scale= are mutually exclusive (the "
+                "manual gradient path owns the backward's reduction; "
+                "fp16 scaling is an amp-path feature)")
+        if mesh is None:
+            raise ValueError("comm= needs the mesh the step trains on")
+        from edl_tpu.train.comm import make_comm_train_step
+        return make_comm_train_step(loss_fn, mesh=mesh, config=comm,
+                                    topology=topology, donate=donate)
     def apply(state, grads, aux):
         """Fold optional BN stats + apply the update (shared by both
         branches so the batch_stats contract lives in one place)."""

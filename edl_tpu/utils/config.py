@@ -101,6 +101,10 @@ ENV_VARS: dict[str, str] = {
     "EDL_TPU_PREFETCH_BATCHES": "host->device prefetch depth",
     "EDL_TPU_LOADER_WORKERS": "mp input-plane worker processes (0 = inline)",
     "EDL_TPU_AUGMENT_DEVICE": "jitted on-device crop/flip/normalize",
+    "EDL_TPU_COMM_BUCKET_MB": "gradient reduction bucket size MiB "
+                              "(0 = XLA-partitioned single reduction)",
+    "EDL_TPU_DCN_COMPRESS": "cross-slice gradient wire format: "
+                            "off | topk | int8 (loss-parity gated)",
     "EDL_TPU_DISTILL_NOP": "distill reader no-op mode (wire debugging)",
     # -- logging / profiling ------------------------------------------------
     "EDL_TPU_LOG_DIR": "launcher workerlog directory",

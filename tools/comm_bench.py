@@ -1,0 +1,155 @@
+"""DCN gradient-path tuner: bucket-size x wire-format x layout sweep.
+
+Local sizing companion to the DCN-aware gradient path
+(edl_tpu/train/comm.py, doc/design_comm.md): one seeded tiny
+transformer trained through every {bucket_mb} x {dense, topk, int8} x
+{flat, hybrid} combination, printed as a markdown table of
+
+  step time | per-chip cross-slice bytes/step | schedulable overlap %
+  | parity vs the jit step
+
+Seeded-exact: the model init, the batch, the bucket plan and the
+compressed selections are all functions of --seed, so two runs on the
+same machine produce the same table (timings jitter; every non-timing
+column is stable). Runs on the CPU harness — where every byte rides
+the same host links, so step-time columns are SCHEDULE-COST parity
+checks, not a DCN win; the bytes columns are exact wire accounting
+either way (what you'd save on real cross-slice fabric).
+
+  python tools/comm_bench.py --buckets 0.05,0.25 --steps 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/comm_bench.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
+
+
+def build_world(seed: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax.core import meta
+
+    from edl_tpu.models.transformer import (Transformer,
+                                            TransformerConfig, lm_loss_fn)
+    from edl_tpu.train.state import TrainState
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 or n_dev % 2:
+        raise SystemExit(f"need an even multi-device world (have "
+                         f"{n_dev}); run under "
+                         "XLA_FLAGS=--xla_force_host_platform_device_"
+                         "count=8")
+    vocab, seq = 128, 32
+    cfg = TransformerConfig(vocab_size=vocab, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=256, max_len=seq,
+                            dtype=jnp.float32, mesh=None)
+    model = Transformer(cfg)
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, vocab,
+                        size=(4 * n_dev, seq)).astype(np.int32)
+    variables = meta.unbox(model.init(jax.random.PRNGKey(seed),
+                                      jnp.asarray(toks), train=False))
+    state = TrainState.create(apply_fn=model.apply,
+                              params=variables["params"],
+                              tx=optax.sgd(0.1, momentum=0.9))
+    return lm_loss_fn, state, {"tokens": toks}, n_dev
+
+
+def time_step(step_fn, state, placed, steps: int, mesh) -> float:
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    s = jax.tree.map(lambda a: jax.device_put(
+        a, NamedSharding(mesh, P())), state)
+    for _ in range(2):
+        s, m = step_fn(s, placed)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        s, m = step_fn(s, placed)
+    float(m["loss"])
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tools/comm_bench.py")
+    parser.add_argument("--buckets", default="0.05,0.25",
+                        help="comma list of bucket MiB targets")
+    parser.add_argument("--steps", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=5)
+    parser.add_argument("--topk-frac", type=float, default=0.125)
+    args = parser.parse_args(argv)
+
+    from edl_tpu.parallel import mesh as mesh_lib
+    from edl_tpu.train import comm
+    from edl_tpu.train.step import make_train_step
+
+    loss_fn, state, batch, n_dev = build_world(args.seed)
+    topo = mesh_lib.SliceTopology(2, n_dev // 2)
+    worlds = {
+        "flat": (mesh_lib.make_mesh(mesh_lib.MeshSpec({"dp": -1})),
+                 None),
+        "hybrid": (mesh_lib.make_hybrid_mesh(
+            mesh_lib.MeshSpec({"dp": -1}), topo), topo),
+    }
+    rows = []
+    # the jit reference per layout (bucket size is meaningless there)
+    for layout, (mesh, _) in worlds.items():
+        placed = mesh_lib.shard_batch(mesh, batch)
+        ms = time_step(make_train_step(loss_fn, donate=False), state,
+                       placed, args.steps, mesh)
+        rows.append((layout, "jit", "-", round(ms, 2), "-", "-", "-"))
+    for bucket_mb in [float(b) for b in args.buckets.split(",") if b]:
+        for layout, (mesh, topo_) in worlds.items():
+            placed = mesh_lib.shard_batch(mesh, batch)
+            for mode in ("off", "topk", "int8"):
+                cfgc = comm.CommConfig(bucket_mb=bucket_mb,
+                                       compress=mode,
+                                       topk_frac=args.topk_frac,
+                                       min_compress_elems=64)
+                step = comm.make_comm_train_step(
+                    loss_fn, mesh=mesh, topology=topo_, donate=False,
+                    config=cfgc)
+                ms = time_step(step, state, placed, args.steps, mesh)
+                gate = comm.loss_parity_gate(
+                    loss_fn, state, batch, mesh=mesh, config=cfgc,
+                    topology=topo_, steps=2, envelope=1e-1)
+                parity = ("bitwise" if gate["bitwise_dense"]
+                          else "loss" if gate["dense_loss_delta"] <= 1e-4
+                          else "DIVERGED")
+                if mode != "off":
+                    parity += ("+env" if gate.get("loss_envelope_ok")
+                               else "+OVER")
+                rows.append((layout,
+                             "dense" if mode == "off" else mode,
+                             bucket_mb, round(ms, 2),
+                             step.dcn_bytes_per_step(),
+                             step.dcn_overlap_pct(), parity))
+
+    print(f"# comm_bench seed={args.seed} world={n_dev} "
+          f"topology=2x{n_dev // 2} topk_frac={args.topk_frac}\n")
+    print("| layout | wire | bucket MiB | step ms | dcn B/step/chip "
+          "| overlap % | parity |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        print("| " + " | ".join(str(c) for c in r) + " |")
+    print("\nstep-ms columns are CPU-harness schedule costs (no DCN "
+          "here); bytes/overlap are exact wire accounting. parity: "
+          "bitwise = identical to the jit step, loss = equal loss at "
+          "float tolerance (re-associated hierarchical sum), +env = "
+          "compressed run inside the transient loss envelope.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
