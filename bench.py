@@ -1210,6 +1210,97 @@ def bench_checkpoint(on_tpu: bool) -> dict:
             "ckpt_state_mb": round(state_mb, 2)}
 
 
+def bench_fused_opt(on_tpu: bool) -> dict:
+    """Fused optimizer path (train/fused_opt.py): isolated update cost
+    + resident/checkpoint byte cut, gated on the kernel parity report.
+
+    - `opt_update_ms{,_fused,_int8}`: ms/step for the jitted
+      apply_gradients alone (no fwd/bwd) on a ~2M-param world — the
+      optax adamw chain vs the fused fp32 vs fused int8-moment path.
+      On the CPU harness the fused columns run the jitted XLA fallback
+      (the Pallas kernel is a TPU path), so they calibrate expression/
+      schedule cost; the VMEM single-pass win is TPU-only.
+    - `opt_state_bytes{,_int8}` + `opt_state_bytes_cut_x`: resident
+      moment bytes (the >= 1.8x acceptance floor rides CI, this is the
+      artifact number).
+    - `opt_ckpt_state_bytes{,_int8}`: the SERIALIZED state payload
+      (CheckpointManager state_bytes_last) — the same cut as it lands
+      on disk.
+    - `opt_resize_bytes_from_peers{,_int8}`: the donor-manifest bytes
+      (sharded_checkpoint.snapshot_nbytes — exactly what
+      restore_from_peers moves for a full joiner restore and what the
+      donor advert quotes): the migration-wire half of the cut.
+    - `opt_parity_ok`: update_parity_gate()["ok"] (fused-fp32 sgdm
+      bitwise vs optax + kernel==XLA for every mode), the gate the
+      numbers are meaningless without.
+    """
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from edl_tpu.train import fused_opt as fo
+    from edl_tpu.train.checkpoint import CheckpointManager
+    from edl_tpu.train.state import TrainState, TrainStatus
+
+    rng = np.random.default_rng(0)
+
+    def leaf(*shape):
+        return jnp.asarray(rng.normal(0, 0.02, size=shape)
+                           .astype(np.float32))
+
+    params = {f"w{i}": leaf(512, 512) for i in range(8)}
+    params["tail"] = leaf(129)          # exercises lane padding
+    grads = {k: leaf(*v.shape) for k, v in params.items()}
+
+    def timed(tx):
+        state = TrainState.create(
+            apply_fn=None, params=jax.tree.map(jnp.copy, params), tx=tx)
+        step = jax.jit(lambda s, g: s.apply_gradients(grads=g),
+                       donate_argnums=(0,))
+        state = step(state, grads)
+        jax.block_until_ready(jax.tree.leaves(state))
+        n = 20
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state = step(state, grads)
+        jax.block_until_ready(jax.tree.leaves(state))
+        return ((time.perf_counter() - t0) / n * 1e3,
+                fo.opt_state_bytes(state.opt_state), state)
+
+    dense_ms, dense_bytes, dense_state = timed(optax.adamw(1e-3))
+    fused_ms, _, _ = timed(fo.fused_adam(1e-3, bucket_mb=4.0))
+    int8_ms, int8_bytes, int8_state = timed(
+        fo.fused_adam(1e-3, quant="int8", bucket_mb=4.0))
+
+    # serialized payload, dense vs quantized moments (the disk/wire cut)
+    from edl_tpu.train import sharded_checkpoint as _sc
+
+    root = _tempfile.mkdtemp(prefix="edl-opt-bench-")
+    try:
+        ckpt_bytes, peer_bytes = {}, {}
+        for name, st in (("dense", dense_state), ("int8", int8_state)):
+            mgr = CheckpointManager(os.path.join(root, name),
+                                    process_index=0)
+            mgr.save(st, TrainStatus(epoch=0, step=1))
+            ckpt_bytes[name] = mgr.stats()["state_bytes_last"]
+            peer_bytes[name] = _sc.snapshot_nbytes(
+                _sc.snapshot_host_tree(st))
+    finally:
+        _shutil.rmtree(root, ignore_errors=True)
+
+    return {"opt_update_ms": round(dense_ms, 3),
+            "opt_update_ms_fused": round(fused_ms, 3),
+            "opt_update_ms_int8": round(int8_ms, 3),
+            "opt_state_bytes": dense_bytes,
+            "opt_state_bytes_int8": int8_bytes,
+            "opt_state_bytes_cut_x": round(dense_bytes
+                                           / max(int8_bytes, 1), 2),
+            "opt_ckpt_state_bytes": ckpt_bytes["dense"],
+            "opt_ckpt_state_bytes_int8": ckpt_bytes["int8"],
+            "opt_resize_bytes_from_peers": peer_bytes["dense"],
+            "opt_resize_bytes_from_peers_int8": peer_bytes["int8"],
+            "opt_parity_ok": fo.update_parity_gate(steps=2)["ok"]}
+
+
 def bench_elastic_downtime(on_tpu: bool) -> dict:
     """Elastic stop-resume downtime, measured for real: SIGKILL a
     training process mid-run (checkpoints every few steps, async), then
@@ -1930,6 +2021,7 @@ def main() -> None:
     distill = bench_distill(on_tpu)
     churn = bench_distill_churn(on_tpu)
     ckpt = bench_checkpoint(on_tpu)
+    fused = bench_fused_opt(on_tpu)
     downtime = bench_elastic_downtime(on_tpu)
     p2p = bench_elastic_downtime_p2p(on_tpu)
     if downtime.get("elastic_downtime_s") \
@@ -2087,6 +2179,11 @@ def main() -> None:
             # vs async snapshot-then-write, + write/restore wall time
             # and the bitwise sync==async payload check
             **ckpt,
+            # fused optimizer path: isolated update ms (optax vs fused
+            # fp32 vs int8 moments), resident + serialized state-byte
+            # cut, all gated on the kernel parity report
+            # (tools/opt_bench.py has the optimizer x impl x size sweep)
+            **fused,
             # elastic stop-resume downtime: SIGKILL a trainer mid-run,
             # respawn, clock kill -> first post-restore step
             **downtime,

@@ -589,13 +589,16 @@ class MigrationService:
         if snap is None:
             return
         self.server.publish(snap)
+        from edl_tpu.train.sharded_checkpoint import snapshot_nbytes
+        # as-stored bytes: a state with quantized resident moments
+        # (train/fused_opt.py) adverts — and serves — the int8 planes,
+        # so joiners budget the real wire cost, ~2x under the fp32 one
         doc = {"pod_id": self.pod_id, "addr": self.addr,
                "port": self.server.port,
                "version": snap["version"],
                "step": (snap["status"] or {}).get("step"),
                "generation": self.generation,
-               "nbytes": int(sum(a.nbytes
-                                 for a in snap["chunks"].values())),
+               "nbytes": snapshot_nbytes(snap),
                "ts": time.time()}
         with self._lock:
             self._advert_doc = doc

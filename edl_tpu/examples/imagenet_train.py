@@ -196,6 +196,17 @@ def main(argv=None) -> int:
                              "overlap earlier buckets' communication "
                              "(default $EDL_TPU_COMM_BUCKET_MB, else 0 "
                              "= XLA's single fused reduction)")
+    parser.add_argument("--fused-opt",
+                        choices=("off", "fp32", "int8", "fp8"),
+                        default=None,
+                        help="fused optimizer path (train/fused_opt.py; "
+                             "default $EDL_TPU_FUSED_OPT, else off): "
+                             "fp32 = momentum-SGD as one kernel pass "
+                             "per bucket, bitwise vs the optax chain; "
+                             "int8/fp8 also hold the momentum "
+                             "quantized with error-feedback residuals "
+                             "(opt state and checkpoint bytes halve, "
+                             "convergence-parity gated)")
     parser.add_argument("--dgc-sparsity", type=float, default=0.0,
                         help="deep gradient compression: fraction of "
                              "gradient entries dropped (0 = off; the "
@@ -372,6 +383,27 @@ def main(argv=None) -> int:
         from edl_tpu.train.comm import CommConfig
         comm_cfg = CommConfig(bucket_mb=comm_bucket_mb or 4.0,
                               compress=dcn_compress)
+    # Fused optimizer path: CLI > env (LoopConfig binding) > off;
+    # EDL_TPU_OPT_QUANT overrides just the resident-moment codec.
+    fused_opt = (args.fused_opt if args.fused_opt is not None
+                 else loop_cfg.fused_opt)
+    if loop_cfg.opt_quant and fused_opt != "off":
+        if loop_cfg.opt_quant not in ("off", "int8", "fp8"):
+            raise SystemExit(f"EDL_TPU_OPT_QUANT must be off|int8|fp8, "
+                             f"got {loop_cfg.opt_quant!r}")
+        fused_opt = ("fp32" if loop_cfg.opt_quant == "off"
+                     else loop_cfg.opt_quant)
+    if fused_opt not in ("off", "fp32", "int8", "fp8"):
+        raise SystemExit(f"EDL_TPU_FUSED_OPT must be off|fp32|int8|fp8, "
+                         f"got {fused_opt!r}")
+    if fused_opt != "off" and args.dgc_sparsity > 0:
+        raise SystemExit(
+            "--fused-opt and --dgc-sparsity are mutually exclusive: "
+            "DGC's momentum correction REPLACES optimizer momentum "
+            "inside an optax chain, while the fused path owns the "
+            "whole momentum update in-kernel. Pick one compression "
+            "story (DGC sparsifies the wire, fused-int8 shrinks "
+            "resident state).")
     data_sharding = mesh_lib.data_sharding(mesh)
     normalize = None
     if args.data_format == "jpeg":
@@ -447,6 +479,14 @@ def main(argv=None) -> int:
                 rampup_steps=args.dgc_rampup_epochs * steps_per_epoch),
             optax.add_decayed_weights(args.weight_decay),
             optax.sgd(schedule))
+    elif fused_opt != "off":
+        from edl_tpu.train.fused_opt import make_fused_tx
+        # same math as the optax chain below (fp32 mode is bitwise):
+        # decayed weights fold into the momentum update in-kernel
+        tx = make_fused_tx("sgdm", schedule, fused_opt,
+                           momentum=args.momentum,
+                           weight_decay=args.weight_decay)
+        log.info("fused optimizer path: sgd-m %s", fused_opt)
     else:
         tx = optax.chain(
             optax.add_decayed_weights(args.weight_decay),

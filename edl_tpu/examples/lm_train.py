@@ -107,6 +107,23 @@ def main(argv=None) -> int:
                              "overlap earlier buckets' communication "
                              "(default $EDL_TPU_COMM_BUCKET_MB, else 0 "
                              "= XLA's single fused reduction)")
+    parser.add_argument("--fused-opt",
+                        choices=("off", "fp32", "int8", "fp8"),
+                        default=None,
+                        help="fused optimizer path (train/fused_opt.py; "
+                             "default $EDL_TPU_FUSED_OPT, else off): "
+                             "fp32 = one kernel pass per bucket, "
+                             "bitwise vs the optax chain; int8/fp8 also "
+                             "hold the adam moments quantized with "
+                             "error-feedback residuals (opt state and "
+                             "checkpoint bytes halve, convergence-"
+                             "parity gated)")
+    parser.add_argument("--remat", choices=("off", "on", "auto"),
+                        default="off",
+                        help="per-block activation checkpointing: on = "
+                             "always, auto = models.transformer."
+                             "choose_remat decides from the activation-"
+                             "footprint estimate vs device memory")
     parser.add_argument("--mesh", choices=("dp", "fsdp", "sp"),
                         default="dp",
                         help="dp: data parallel; fsdp: params sharded; "
@@ -216,6 +233,26 @@ def main(argv=None) -> int:
         from edl_tpu.train.comm import CommConfig
         comm_cfg = CommConfig(bucket_mb=comm_bucket_mb or 4.0,
                               compress=dcn_compress)
+    # Fused optimizer path: CLI > env (LoopConfig binding) > off;
+    # EDL_TPU_OPT_QUANT overrides just the resident-moment codec.
+    fused_opt = (args.fused_opt if args.fused_opt is not None
+                 else loop_cfg.fused_opt)
+    if loop_cfg.opt_quant and fused_opt != "off":
+        if loop_cfg.opt_quant not in ("off", "int8", "fp8"):
+            raise SystemExit(f"EDL_TPU_OPT_QUANT must be off|int8|fp8, "
+                             f"got {loop_cfg.opt_quant!r}")
+        fused_opt = ("fp32" if loop_cfg.opt_quant == "off"
+                     else loop_cfg.opt_quant)
+    if fused_opt not in ("off", "fp32", "int8", "fp8"):
+        raise SystemExit(f"EDL_TPU_FUSED_OPT must be off|fp32|int8|fp8, "
+                         f"got {fused_opt!r}")
+    if args.fp16 and fused_opt in ("int8", "fp8"):
+        raise SystemExit(
+            "--fused-opt int8/fp8 is not supported with --fp16: on a "
+            "non-finite step the loss-scaler rolls the state back, but "
+            "quantized moments would still carry the overflowed "
+            "requantization residuals. Use --fused-opt fp32 (bitwise, "
+            "rollback-safe) or bf16/fp32 activations.")
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,
@@ -225,6 +262,14 @@ def main(argv=None) -> int:
         # constraints / nested shard_maps would clash with the manual
         # dp axis — each shard computes exactly one chip's backward
         mesh=None if comm_cfg is not None else mesh)
+    if args.remat != "off":
+        import dataclasses
+
+        from edl_tpu.models.transformer import auto_remat
+        cfg = (auto_remat(cfg, local_bs)
+               if args.remat == "auto"
+               else dataclasses.replace(cfg, remat=True))
+        log.info("remat=%s (mode %s)", cfg.remat, args.remat)
     model = Transformer(cfg)
 
     source = FileSource(files)
@@ -237,7 +282,13 @@ def main(argv=None) -> int:
     schedule = lr_lib.cosine_with_warmup(
         args.lr, total_steps,
         min(args.warmup_steps, max(1, total_steps // 10)))
-    tx = optax.adamw(schedule, weight_decay=0.01)
+    if fused_opt != "off":
+        from edl_tpu.train.fused_opt import make_fused_tx
+        tx = make_fused_tx("adam", schedule, fused_opt,
+                           weight_decay=0.01)
+        log.info("fused optimizer path: adam %s", fused_opt)
+    else:
+        tx = optax.adamw(schedule, weight_decay=0.01)
 
     toks0 = jnp.zeros((1, args.seq_len), jnp.int32)
     variables = shd.init_sharded(

@@ -245,3 +245,52 @@ def lm_loss_fused(state, params, batch, *, chunk: int = 8192):
     kernel = params["lm_head"]["kernel"]
     loss = streamed_lm_xent(hidden, kernel, targets, chunk)
     return loss, {"ppl": jnp.exp(loss)}
+
+
+def choose_remat(cfg: TransformerConfig, batch_size: int,
+                 seq_len: int | None = None,
+                 hbm_bytes: int | None = None,
+                 budget_frac: float = 0.6) -> bool:
+    """Autotuned remat knob: does the backward's activation footprint
+    fit, or should blocks be checkpointed?
+
+    Pure arithmetic over the config (deterministic, testable): the
+    no-remat backward keeps every block's saved activations live at
+    once — roughly 12 d_model-wide tensors per block (embeddings, qkv,
+    attn out, both mlp halves), plus the (heads, S, S) score matrix
+    when attention is dense — while remat keeps ONE block's worth and
+    recomputes the rest. If the no-remat estimate exceeds
+    ``budget_frac`` of what is left after params + fp32 moments, remat
+    pays its ~30% recompute FLOPs. ``hbm_bytes`` defaults to the
+    backend device's reported memory, or a 16 GiB TPU-core default
+    when the backend (CPU harness) reports none.
+    """
+    seq = seq_len or cfg.max_len
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    per_block = 12 * batch_size * seq * cfg.d_model * itemsize
+    if cfg.attention == "dense" or (
+            cfg.attention == "auto" and not cfg.use_ring
+            and jax.default_backend() != "tpu"):
+        per_block += batch_size * cfg.n_heads * seq * seq * itemsize
+    activations = cfg.n_layers * per_block
+    n_params = (cfg.vocab_size * cfg.d_model * 2          # embed + head
+                + cfg.max_len * cfg.d_model
+                + cfg.n_layers * (4 * cfg.d_model ** 2
+                                  + 2 * cfg.d_model * cfg.d_ff))
+    resident = n_params * (4 + 8)                          # fp32 + adam
+    if hbm_bytes is None:
+        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+        hbm_bytes = (stats or {}).get("bytes_limit", 16 * (1 << 30))
+    return activations > budget_frac * max(hbm_bytes - resident,
+                                           hbm_bytes // 8)
+
+
+def auto_remat(cfg: TransformerConfig, batch_size: int,
+               seq_len: int | None = None,
+               hbm_bytes: int | None = None) -> TransformerConfig:
+    """cfg with ``remat`` set by :func:`choose_remat` (no-op when the
+    estimate says activations fit)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        cfg, remat=choose_remat(cfg, batch_size, seq_len, hbm_bytes))

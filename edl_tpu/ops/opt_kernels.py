@@ -1,0 +1,437 @@
+"""Fused optimizer-update kernels over flat parameter buckets.
+
+One Pallas VMEM pass per bucket: grad + param + moments stream through
+VMEM once and the whole momentum-SGD / Adam update (including the
+dequant-update-requant round trip when moments are held quantized)
+happens in registers, instead of XLA's long chain of elementwise HLOs
+that re-reads HBM between every multiply. Buckets come from the same
+planner as the DCN gradient path (train/comm.py plan_buckets): flat,
+dtype-grouped, lane-padded buffers a few MiB each — well inside VMEM.
+
+Backend split mirrors ops/pack.py exactly: the kernel path runs on TPU
+(or under `force_pallas_interpret()` in tests), everywhere else the
+plain-XLA expression is used. Both paths are built from the SAME jnp
+math helpers (`_sgdm_math`, `_adam_math`, the shared quantize helpers
+in ops/pack.py), so interpret-mode kernel output is bitwise-identical
+to the XLA fallback by construction — the equivalence the tests pin.
+
+Quantized resident moments (`quant='int8'`/`'fp8'`): between steps a
+moment plane lives as TWO int8 payloads + two fp32 scales per bucket —
+the symmetric-int8 quantization of the moment itself, plus the
+symmetric-int8 quantization of the rounding RESIDUAL (error feedback,
+generalizing the r21 residual machinery in train/comm.py). Since
+|residual| <= scale/2, the residual's own scale is <= scale/254: the
+pair behaves like ~16-bit fixed precision while costing 2 bytes per
+element (vs 4 for fp32 — the >= 1.8x resident/checkpoint/migration
+byte cut), and the mass dropped per requant is second-order
+(<= scale/508 per element). 'fp8' stores float8_e4m3fn bits BITCAST to
+int8 at rest, so serialization and the tensor wire never see an fp8
+dtype ("fp8-shaped on CPU via the int8 wire").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from edl_tpu.ops.pack import (dequantize_int8, quantize_int8,
+                              symmetric_scale)
+
+_LANE = 128         # TPU lane width: kernel operands reshape to (-1, 128)
+_FORCE_INTERPRET = False
+
+OPTIMIZERS = ("sgdm", "adam")
+QUANT_MODES = ("off", "int8", "fp8")
+
+
+def force_pallas_interpret():
+    """Test hook: route the fused update through the Pallas kernels in
+    interpret mode on non-TPU backends (equivalence pinning only)."""
+    global _FORCE_INTERPRET
+    _FORCE_INTERPRET = True
+
+
+def _use_pallas() -> bool:
+    return _FORCE_INTERPRET or jax.default_backend() == "tpu"
+
+
+# -- fp8 plane codec (rides the int8 wire) ----------------------------------
+
+FP8_MAX = 448.0     # float8_e4m3fn finite max
+
+
+def fp8_dtype():
+    """float8_e4m3fn if this jax build has it, else None."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def _fp8_scale(x: jnp.ndarray) -> jnp.ndarray:
+    amax = jnp.max(jnp.abs(x))
+    return jnp.where(amax > 0, amax / FP8_MAX, 1.0).astype(jnp.float32)
+
+
+def _quantize_fp8(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    f8 = (x.astype(jnp.float32) / scale).astype(fp8_dtype())
+    return jax.lax.bitcast_convert_type(f8, jnp.int8)
+
+
+def _dequantize_fp8(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    f8 = jax.lax.bitcast_convert_type(q, fp8_dtype())
+    return f8.astype(jnp.float32) * scale.astype(jnp.float32)
+
+
+# -- quantized moment plane --------------------------------------------------
+
+
+# Adam's SECOND moment always uses the fp8-e4m3 codec (bits still ride
+# the int8 wire): v spans many orders of magnitude and sits under a
+# sqrt in the update's denominator, so a LINEAR int8 grid zero-floors
+# small entries — u = m/(sqrt(0)+eps) then explodes wherever the m
+# plane still resolves the entry. An exponent format keeps ~6% relative
+# precision across v's whole range; the first moment (gradient-like,
+# error-feedback-friendly) stays on the mode's own codec.
+V_QUANT = "fp8"
+
+
+class QPlane(NamedTuple):
+    """One moment plane at rest: value payload + error-feedback residual.
+
+    q/rq are int8 (fp8 mode: float8 bits bitcast to int8); scale/rscale
+    are fp32 scalars. Serializes as four ordinary array leaves — the
+    (q, scale) pairs checkpoints/migration ship at half the fp32 bytes.
+    """
+
+    q: jnp.ndarray
+    scale: jnp.ndarray
+    rq: jnp.ndarray
+    rscale: jnp.ndarray
+
+
+def _dq2(q, scale, rq, rscale, quant: str) -> jnp.ndarray:
+    """Reassemble the full-precision moment: payload + residual."""
+    if quant == "int8":
+        return dequantize_int8(q, scale) + dequantize_int8(rq, rscale)
+    return _dequantize_fp8(q, scale) + _dequantize_fp8(rq, rscale)
+
+
+def _rq2(m: jnp.ndarray, quant: str):
+    """Requantize an updated moment; the rounding error becomes the new
+    residual (itself quantized — that is what halves the bytes)."""
+    if quant == "int8":
+        scale = symmetric_scale(m)
+        q = quantize_int8(m, scale)
+        r = m - dequantize_int8(q, scale)
+        rscale = symmetric_scale(r)
+        rq = quantize_int8(r, rscale)
+    else:
+        scale = _fp8_scale(m)
+        q = _quantize_fp8(m, scale)
+        r = m - _dequantize_fp8(q, scale)
+        rscale = _fp8_scale(r)
+        rq = _quantize_fp8(r, rscale)
+    return q, scale, rq, rscale
+
+
+def quant_plane(m: jnp.ndarray, quant: str) -> QPlane:
+    """Full-precision moment -> resident QPlane."""
+    q, scale, rq, rscale = _rq2(m.astype(jnp.float32), quant)
+    return QPlane(q=q, scale=scale, rq=rq, rscale=rscale)
+
+
+def dequant_plane(plane: QPlane, quant: str) -> jnp.ndarray:
+    """Resident QPlane -> full-precision moment (payload + residual)."""
+    return _dq2(plane.q, plane.scale, plane.rq, plane.rscale, quant)
+
+
+def zero_plane(n: int, quant: str) -> QPlane:
+    """Quantized zero moment (exact: symmetric format round-trips 0)."""
+    del quant  # both codecs encode zero as q=0, scale=1
+    return QPlane(q=jnp.zeros((n,), jnp.int8),
+                  scale=jnp.ones((), jnp.float32),
+                  rq=jnp.zeros((n,), jnp.int8),
+                  rscale=jnp.ones((), jnp.float32))
+
+
+# -- optimizer math (the single source of truth for BOTH backends) ----------
+# Expression order matters: the momentum-SGD chain is written to be
+# bitwise-identical to optax.chain(add_decayed_weights(wd),
+# sgd(lr, momentum=mu)) + optax.apply_updates (tests pin it); Adam
+# matches optax.adamw's expression order with bias-correction factors
+# (c1, c2) precomputed outside and eps_root=0.
+
+
+def _sgdm_math(p, g, m, lr, mu: float, wd: float):
+    if wd:
+        g = g + wd * p
+    m_new = g + mu * m
+    p_new = p + m_new * (-lr)
+    return p_new, m_new
+
+
+def _adam_math(p, g, m, v, lr, c1, c2, b1: float, b2: float,
+               eps: float, wd: float):
+    # v >= +0.0 exactly on the fp32 path (so the clamp is bitwise-
+    # neutral there); a dequantized v can carry a tiny negative
+    # residual error, which must not reach the sqrt.
+    v = jnp.maximum(v, 0.0)
+    m_new = (1 - b1) * g + b1 * m
+    v_new = (1 - b2) * (g * g) + b2 * v
+    u = (m_new / c1) / (jnp.sqrt(v_new / c2) + eps)
+    if wd:
+        u = u + wd * p
+    p_new = p + u * (-lr)
+    return p_new, m_new, v_new
+
+
+# -- Pallas kernel bodies ----------------------------------------------------
+# Scalars ride as (1, 1) fp32 operands (SMEM-shaped); hyperparameters
+# that never change per step (mu, b1, ...) are compile-time statics.
+
+
+def _sgdm_fp32_kernel(p_ref, g_ref, m_ref, lr_ref, po_ref, mo_ref,
+                      *, mu, wd):
+    p_new, m_new = _sgdm_math(p_ref[:], g_ref[:], m_ref[:],
+                              lr_ref[0, 0], mu, wd)
+    po_ref[:] = p_new
+    mo_ref[:] = m_new
+
+
+def _sgdm_q_kernel(p_ref, g_ref, q_ref, s_ref, rq_ref, rs_ref, lr_ref,
+                   po_ref, qo_ref, so_ref, rqo_ref, rso_ref,
+                   *, mu, wd, quant):
+    m = _dq2(q_ref[:], s_ref[0, 0], rq_ref[:], rs_ref[0, 0], quant)
+    p_new, m_new = _sgdm_math(p_ref[:], g_ref[:], m, lr_ref[0, 0],
+                              mu, wd)
+    q, s, rq, rs = _rq2(m_new, quant)
+    po_ref[:] = p_new
+    qo_ref[:] = q
+    so_ref[0, 0] = s
+    rqo_ref[:] = rq
+    rso_ref[0, 0] = rs
+
+
+def _adam_fp32_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, c1_ref,
+                      c2_ref, po_ref, mo_ref, vo_ref,
+                      *, b1, b2, eps, wd):
+    p_new, m_new, v_new = _adam_math(
+        p_ref[:], g_ref[:], m_ref[:], v_ref[:], lr_ref[0, 0],
+        c1_ref[0, 0], c2_ref[0, 0], b1, b2, eps, wd)
+    po_ref[:] = p_new
+    mo_ref[:] = m_new
+    vo_ref[:] = v_new
+
+
+def _adam_q_kernel(p_ref, g_ref, qm_ref, sm_ref, rqm_ref, rsm_ref,
+                   qv_ref, sv_ref, rqv_ref, rsv_ref, lr_ref, c1_ref,
+                   c2_ref, po_ref, qmo_ref, smo_ref, rqmo_ref,
+                   rsmo_ref, qvo_ref, svo_ref, rqvo_ref, rsvo_ref,
+                   *, b1, b2, eps, wd, quant):
+    m = _dq2(qm_ref[:], sm_ref[0, 0], rqm_ref[:], rsm_ref[0, 0], quant)
+    v = _dq2(qv_ref[:], sv_ref[0, 0], rqv_ref[:], rsv_ref[0, 0],
+             V_QUANT)
+    p_new, m_new, v_new = _adam_math(
+        p_ref[:], g_ref[:], m, v, lr_ref[0, 0], c1_ref[0, 0],
+        c2_ref[0, 0], b1, b2, eps, wd)
+    qm, sm, rqm, rsm = _rq2(m_new, quant)
+    qv, sv, rqv, rsv = _rq2(v_new, V_QUANT)
+    po_ref[:] = p_new
+    qmo_ref[:] = qm
+    smo_ref[0, 0] = sm
+    rqmo_ref[:] = rqm
+    rsmo_ref[0, 0] = rsm
+    qvo_ref[:] = qv
+    svo_ref[0, 0] = sv
+    rqvo_ref[:] = rqv
+    rsvo_ref[0, 0] = rsv
+
+
+# -- jitted XLA fallbacks ----------------------------------------------------
+# The fallback expressions are jitted so XLA applies the SAME fusion
+# (notably fma contraction) whether the bucket update runs standalone
+# (the parity gate) or inlined in a jitted train step — eager op-by-op
+# execution would differ from the compiled kernel path by an ulp.
+
+
+@functools.partial(jax.jit, static_argnames=("mu", "wd"))
+def _sgdm_xla_fp32(p, g, m, lr, *, mu, wd):
+    return _sgdm_math(p, g, m, lr, mu, wd)
+
+
+@functools.partial(jax.jit, static_argnames=("mu", "wd", "quant"))
+def _sgdm_xla_q(p, g, q, s, rq, rs, lr, *, mu, wd, quant):
+    m = _dq2(q, s, rq, rs, quant)
+    p_new, m_new = _sgdm_math(p, g, m, lr, mu, wd)
+    return (p_new,) + _rq2(m_new, quant)
+
+
+@functools.partial(jax.jit, static_argnames=("b1", "b2", "eps", "wd"))
+def _adam_xla_fp32(p, g, m, v, lr, c1, c2, *, b1, b2, eps, wd):
+    return _adam_math(p, g, m, v, lr, c1, c2, b1, b2, eps, wd)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("b1", "b2", "eps", "wd", "quant"))
+def _adam_xla_q(p, g, qm, sm, rqm, rsm, qv, sv, rqv, rsv, lr, c1, c2,
+                *, b1, b2, eps, wd, quant):
+    m = _dq2(qm, sm, rqm, rsm, quant)
+    v = _dq2(qv, sv, rqv, rsv, V_QUANT)
+    p_new, m_new, v_new = _adam_math(p, g, m, v, lr, c1, c2, b1, b2,
+                                     eps, wd)
+    return (p_new,) + _rq2(m_new, quant) + _rq2(v_new, V_QUANT)
+
+
+# -- pallas_call wrappers (jitted once per bucket shape) ---------------------
+
+
+def _shapes(*arrs):
+    return tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs)
+
+
+_S11 = jax.ShapeDtypeStruct((1, 1), jnp.float32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mu", "wd", "interpret"))
+def _sgdm_fp32_pallas(p2, g2, m2, lr, *, mu, wd, interpret):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        functools.partial(_sgdm_fp32_kernel, mu=mu, wd=wd),
+        out_shape=_shapes(p2, m2),
+        interpret=interpret,
+    )(p2, g2, m2, lr)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mu", "wd", "quant", "interpret"))
+def _sgdm_q_pallas(p2, g2, q2, s, rq2, rs, lr, *, mu, wd, quant,
+                   interpret):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        functools.partial(_sgdm_q_kernel, mu=mu, wd=wd, quant=quant),
+        out_shape=_shapes(p2, q2) + (_S11,) + _shapes(rq2) + (_S11,),
+        interpret=interpret,
+    )(p2, g2, q2, s, rq2, rs, lr)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("b1", "b2", "eps", "wd", "interpret"))
+def _adam_fp32_pallas(p2, g2, m2, v2, lr, c1, c2, *, b1, b2, eps, wd,
+                      interpret):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        functools.partial(_adam_fp32_kernel, b1=b1, b2=b2, eps=eps,
+                          wd=wd),
+        out_shape=_shapes(p2, m2, v2),
+        interpret=interpret,
+    )(p2, g2, m2, v2, lr, c1, c2)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("b1", "b2", "eps", "wd", "quant",
+                                    "interpret"))
+def _adam_q_pallas(p2, g2, qm2, sm, rqm2, rsm, qv2, sv, rqv2, rsv, lr,
+                   c1, c2, *, b1, b2, eps, wd, quant, interpret):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        functools.partial(_adam_q_kernel, b1=b1, b2=b2, eps=eps, wd=wd,
+                          quant=quant),
+        out_shape=(_shapes(p2, qm2) + (_S11,) + _shapes(rqm2) + (_S11,)
+                   + _shapes(qv2) + (_S11,) + _shapes(rqv2) + (_S11,)),
+        interpret=interpret,
+    )(p2, g2, qm2, sm, rqm2, rsm, qv2, sv, rqv2, rsv, lr, c1, c2)
+
+
+# -- per-bucket public entry points ------------------------------------------
+# p/g are flat fp32 bucket buffers whose length is a multiple of the
+# 128-element lane width (plan_buckets(align=128) guarantees it; the
+# zero padding is a fixed point of both updates, so it never drifts).
+
+
+def _lanes(x: jnp.ndarray) -> jnp.ndarray:
+    return x.reshape(-1, _LANE)
+
+
+def _s11(x) -> jnp.ndarray:
+    return jnp.asarray(x, jnp.float32).reshape(1, 1)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def sgdm_bucket(p, g, m_state, lr, *, mu: float, wd: float,
+                quant: str = "off"):
+    """Fused momentum-SGD update of one bucket.
+
+    m_state: fp32 buffer (quant='off') or :class:`QPlane`. Returns
+    (p_new, m_state_new) in the same representation.
+    """
+    lr = jnp.asarray(lr, jnp.float32)
+    if quant == "off":
+        if not _use_pallas():
+            return _sgdm_xla_fp32(p, g, m_state, lr, mu=mu, wd=wd)
+        p2, m2 = _sgdm_fp32_pallas(_lanes(p), _lanes(g),
+                                   _lanes(m_state), _s11(lr), mu=mu,
+                                   wd=wd, interpret=_interpret())
+        return p2.reshape(p.shape), m2.reshape(m_state.shape)
+    if not _use_pallas():
+        p_new, q, s, rq, rs = _sgdm_xla_q(
+            p, g, m_state.q, m_state.scale, m_state.rq,
+            m_state.rscale, lr, mu=mu, wd=wd, quant=quant)
+        return p_new, QPlane(q=q, scale=s, rq=rq, rscale=rs)
+    p2, q2, s, rq2, rs = _sgdm_q_pallas(
+        _lanes(p), _lanes(g), _lanes(m_state.q), _s11(m_state.scale),
+        _lanes(m_state.rq), _s11(m_state.rscale), _s11(lr), mu=mu,
+        wd=wd, quant=quant, interpret=_interpret())
+    return p2.reshape(p.shape), QPlane(
+        q=q2.reshape(p.shape), scale=s.reshape(()),
+        rq=rq2.reshape(p.shape), rscale=rs.reshape(()))
+
+
+def adam_bucket(p, g, m_state, v_state, lr, c1, c2, *, b1: float,
+                b2: float, eps: float, wd: float, quant: str = "off"):
+    """Fused Adam(W) update of one bucket.
+
+    c1/c2 are the bias-correction denominators (1 - b^t), precomputed
+    by the caller so kernel and XLA paths consume identical scalars.
+    Returns (p_new, m_state_new, v_state_new).
+    """
+    lr = jnp.asarray(lr, jnp.float32)
+    c1 = jnp.asarray(c1, jnp.float32)
+    c2 = jnp.asarray(c2, jnp.float32)
+    if quant == "off":
+        if not _use_pallas():
+            return _adam_xla_fp32(p, g, m_state, v_state, lr, c1, c2,
+                                  b1=b1, b2=b2, eps=eps, wd=wd)
+        p2, m2, v2 = _adam_fp32_pallas(
+            _lanes(p), _lanes(g), _lanes(m_state), _lanes(v_state),
+            _s11(lr), _s11(c1), _s11(c2), b1=b1, b2=b2, eps=eps, wd=wd,
+            interpret=_interpret())
+        return (p2.reshape(p.shape), m2.reshape(m_state.shape),
+                v2.reshape(v_state.shape))
+    if not _use_pallas():
+        (p_new, qm, sm, rqm, rsm, qv, sv, rqv, rsv) = _adam_xla_q(
+            p, g, m_state.q, m_state.scale, m_state.rq,
+            m_state.rscale, v_state.q, v_state.scale, v_state.rq,
+            v_state.rscale, lr, c1, c2, b1=b1, b2=b2, eps=eps, wd=wd,
+            quant=quant)
+        return (p_new, QPlane(q=qm, scale=sm, rq=rqm, rscale=rsm),
+                QPlane(q=qv, scale=sv, rq=rqv, rscale=rsv))
+    (p2, qm2, sm, rqm2, rsm, qv2, sv, rqv2, rsv) = _adam_q_pallas(
+        _lanes(p), _lanes(g), _lanes(m_state.q), _s11(m_state.scale),
+        _lanes(m_state.rq), _s11(m_state.rscale), _lanes(v_state.q),
+        _s11(v_state.scale), _lanes(v_state.rq), _s11(v_state.rscale),
+        _s11(lr), _s11(c1), _s11(c2), b1=b1, b2=b2, eps=eps, wd=wd,
+        quant=quant, interpret=_interpret())
+    mk = QPlane(q=qm2.reshape(p.shape), scale=sm.reshape(()),
+                rq=rqm2.reshape(p.shape), rscale=rsm.reshape(()))
+    vk = QPlane(q=qv2.reshape(p.shape), scale=sv.reshape(()),
+                rq=rqv2.reshape(p.shape), rscale=rsv.reshape(()))
+    return p2.reshape(p.shape), mk, vk

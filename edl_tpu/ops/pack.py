@@ -43,19 +43,41 @@ def _use_pallas() -> bool:
     return _FORCE_INTERPRET or jax.default_backend() == "tpu"
 
 
-# -- plain-XLA reference (the non-TPU hot path) ------------------------------
+# -- shared symmetric-int8 math (single source of truth) ---------------------
+# Every int8 quantizer in the tree — this pack/unpack wire, the DGC int8
+# value wire (train/dgc.py), and the fused-optimizer moment quantizer
+# (ops/opt_kernels.py) — routes through these three expressions, so
+# equivalence pinned here holds everywhere. All three are jnp-traceable
+# and safe inside Pallas kernel bodies.
 
 
-def _scale_of(x: jnp.ndarray) -> jnp.ndarray:
+def symmetric_scale(x: jnp.ndarray) -> jnp.ndarray:
+    """fp32 scale mapping |x|max -> 127; 1.0 for an all-zero input so
+    q == 0 and dequantize is exact."""
     amax = jnp.max(jnp.abs(x))
-    # all-zero shard: scale 1.0 so q == 0 and dequantize is exact
     return jnp.where(amax > 0, amax / _QMAX, 1.0).astype(jnp.float32)
 
 
+def quantize_int8(x: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Round-to-nearest symmetric int8 under ``scale`` (no zero-point)."""
+    return jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                    -_QMAX, _QMAX).astype(jnp.int8)
+
+
+def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`quantize_int8` — one fp32 multiply."""
+    return q.astype(jnp.float32) * scale.astype(jnp.float32)
+
+
+# -- plain-XLA reference (the non-TPU hot path) ------------------------------
+
+
+_scale_of = symmetric_scale  # original internal name (kept for callers)
+
+
 def _pack_xla(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    scale = _scale_of(x)
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -_QMAX, _QMAX)
-    return q.astype(jnp.int8), scale
+    scale = symmetric_scale(x)
+    return quantize_int8(x, scale), scale
 
 
 # -- Pallas kernel -----------------------------------------------------------
@@ -63,11 +85,9 @@ def _pack_xla(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 
 def _pack_kernel(x_ref, q_ref, s_ref):
     x = x_ref[:].astype(jnp.float32)
-    amax = jnp.max(jnp.abs(x))
-    scale = jnp.where(amax > 0, amax / _QMAX, 1.0)
+    scale = symmetric_scale(x)
     s_ref[0, 0] = scale
-    q_ref[:] = jnp.clip(jnp.round(x / scale),
-                        -_QMAX, _QMAX).astype(jnp.int8)
+    q_ref[:] = quantize_int8(x, scale)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -110,4 +130,4 @@ def pack_int8(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 def unpack_int8(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     """Inverse of :func:`pack_int8` (one multiply — no kernel needed;
     XLA fuses it into the consumer)."""
-    return q.astype(jnp.float32) * scale.astype(jnp.float32)
+    return dequantize_int8(q, scale)

@@ -139,7 +139,7 @@ class CheckpointManager:
         self._tl = timeline("ckpt")
         self._stats = {  # guarded-by: _cond
             "saves_async": 0, "saves_sync": 0, "superseded": 0,
-            "writes": 0, "errors": 0,
+            "writes": 0, "errors": 0, "state_bytes_last": 0,
             "snapshot_ms_last": 0.0, "save_stall_ms_total": 0.0,
             "write_s_last": 0.0, "write_s_total": 0.0}
         # the stats() dict stays the benchlog API; the per-process obs
@@ -222,8 +222,15 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         tmp = tempfile.mkdtemp(prefix=".tmp-ckpt-", dir=self.directory)
         try:
+            payload = serialization.to_bytes(host_state)
+            # serialized size AS STORED — quantized resident moments
+            # (train/fused_opt.py int8 planes) msgpack their codes, so
+            # the ~2x opt-state cut is visible here and in bench's
+            # checkpoint row, not only in HBM
+            with self._cond:
+                self._stats["state_bytes_last"] = len(payload)
             with open(os.path.join(tmp, "state.msgpack"), "wb") as f:
-                f.write(serialization.to_bytes(host_state))
+                f.write(payload)
             meta = {"version": version, "status": status.to_dict()}
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump(meta, f)

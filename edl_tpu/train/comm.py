@@ -283,13 +283,14 @@ def _cross_topk(shard, resid, axis, groups, k):
 def _cross_int8(shard, resid, axis, groups):
     """int8 DCN edge: per-chip symmetric scale, error feedback keeps
     the quantization error local and re-contributed."""
-    from edl_tpu.ops.pack import pack_int8, unpack_int8
+    from edl_tpu.ops.pack import (dequantize_int8, pack_int8,
+                                  unpack_int8)
     u = shard + resid
     q, scale = pack_int8(u)
     all_q = lax.all_gather(q, axis, axis_index_groups=groups)
     all_s = lax.all_gather(scale, axis, axis_index_groups=groups)
-    dense = jnp.sum(all_q.astype(u.dtype)
-                    * all_s.astype(u.dtype)[:, None], axis=0)
+    dense = jnp.sum(dequantize_int8(all_q, all_s[:, None])
+                    .astype(u.dtype), axis=0)
     return dense, u - unpack_int8(q, scale).astype(u.dtype)
 
 

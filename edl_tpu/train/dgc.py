@@ -146,7 +146,7 @@ def dgc(sparsity: float = 0.99, momentum: float = 0.9,
 
 
 def sparse_psum(tree, axis_name: str, keep_frac: float = 0.01,
-                axis_index_groups=None):
+                axis_index_groups=None, wire: str = "fp32"):
     """Cross-worker gradient sum transferring only top-k per worker.
 
     For use INSIDE `shard_map` (where the author owns the collective):
@@ -157,6 +157,13 @@ def sparse_psum(tree, axis_name: str, keep_frac: float = 0.01,
     threshold are simply not contributed (callers wanting DGC's
     convergence behavior keep them in a local residual — the `dgc`
     transform's bookkeeping — and re-contribute later).
+
+    ``wire='int8'`` additionally quantizes the top-k VALUES with the
+    shared symmetric-int8 codec (ops/pack.py — the same scale/round
+    math as the comm path's DCN leg and the fused optimizer's resident
+    moments): k*(1+4) bytes per worker per leaf instead of k*(4+4),
+    one fp32 scale riding along. Indices stay int32 — they address,
+    they don't round.
 
     ``axis_index_groups`` scopes the reduction to subgroups of the axis
     exactly as in `lax.psum` — how a hierarchical decomposition keeps
@@ -170,6 +177,9 @@ def sparse_psum(tree, axis_name: str, keep_frac: float = 0.01,
     Returns a tree of dense summed gradients, identical across workers
     (within each group, when grouped).
     """
+    if wire not in ("fp32", "int8"):
+        raise ValueError(f"wire must be 'fp32' or 'int8', got {wire!r}")
+
     def leaf(v):
         n = v.size
         if n < 64 or keep_frac >= 1.0:
@@ -180,8 +190,18 @@ def sparse_psum(tree, axis_name: str, keep_frac: float = 0.01,
         _, idx = jax.lax.top_k(jnp.abs(flat), k)
         vals = flat[idx]  # signed values at the top-|.| positions
         # (group, k) after gather — the ONLY cross-worker bytes
-        all_vals = lax.all_gather(vals, axis_name,
-                                  axis_index_groups=axis_index_groups)
+        if wire == "int8":
+            from edl_tpu.ops.pack import dequantize_int8, pack_int8
+            q, scale = pack_int8(vals)
+            all_q = lax.all_gather(q, axis_name,
+                                   axis_index_groups=axis_index_groups)
+            all_s = lax.all_gather(scale, axis_name,
+                                   axis_index_groups=axis_index_groups)
+            all_vals = dequantize_int8(all_q,
+                                       all_s[:, None]).astype(v.dtype)
+        else:
+            all_vals = lax.all_gather(
+                vals, axis_name, axis_index_groups=axis_index_groups)
         all_idx = lax.all_gather(idx, axis_name,
                                  axis_index_groups=axis_index_groups)
         dense = jnp.zeros_like(flat).at[all_idx.reshape(-1)].add(

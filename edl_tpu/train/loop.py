@@ -91,6 +91,16 @@ class LoopConfig:
     # step (--dcn-compress / --comm-bucket-mb override).
     comm_bucket_mb: float = field(0.0, env="EDL_TPU_COMM_BUCKET_MB")
     dcn_compress: str = field("off", env="EDL_TPU_DCN_COMPRESS")
+    # Fused optimizer path (train/fused_opt.py): the whole momentum-SGD
+    # / Adam update as one Pallas VMEM pass per parameter bucket.
+    # off = the optax chain; fp32 = fused, bitwise vs optax; int8/fp8 =
+    # fused + quantized resident moments with error-feedback residuals
+    # (opt state, checkpoint and migration bytes halve; convergence-
+    # parity gated). Entrypoints read these (--fused-opt overrides).
+    fused_opt: str = field("off", env="EDL_TPU_FUSED_OPT")
+    # Resident-moment codec override: off | int8 | fp8. Empty = derive
+    # from fused_opt (fp32 -> off, int8 -> int8, fp8 -> fp8).
+    opt_quant: str = field("", env="EDL_TPU_OPT_QUANT")
 
 
 class TrainLoop:

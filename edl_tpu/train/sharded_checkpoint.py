@@ -135,6 +135,24 @@ def save_sharded(directory: str, state: Any) -> list[str]:
     return write_snapshot(directory, snapshot_shards(state))
 
 
+def snapshot_nbytes(snap: dict) -> int:
+    """Total payload bytes of a snapshot's chunks — what a donor advert
+    quotes and a full peer restore moves over the wire.
+
+    Accepts both chunk layouts: the ``snapshot_shards`` /
+    ``snapshot_host_tree`` list of ``(fname, array)`` pairs and the
+    ``sealed_snapshot`` fname->array dict. Counts bytes AS STORED, so
+    quantized optimizer moments (train/fused_opt.py's int8 ``(q, scale,
+    rq, rscale)`` planes — ordinary pytree leaves to this format) show
+    their ~2x cut on disk and on the migration wire, not only in HBM:
+    the codes are serialized and shipped, never a dequantized fp32
+    copy."""
+    chunks = snap["chunks"]
+    arrays = chunks.values() if isinstance(chunks, dict) else (
+        a for _, a in chunks)
+    return int(sum(a.nbytes for a in arrays))
+
+
 def snapshot_host_tree(state: Any) -> dict:
     """Leaf-table + full-array-chunk view of a HOST pytree.
 

@@ -47,9 +47,18 @@ class TrainState:
         )
 
     def apply_gradients(self, *, grads, **kwargs):
-        updates, new_opt_state = self.tx.update(grads, self.opt_state,
-                                                self.params)
-        new_params = optax.apply_updates(self.params, updates)
+        if hasattr(self.tx, "fused_apply"):
+            # Fused bucket path (train/fused_opt.py): no "updates tree"
+            # intermediate — params and moments are rewritten in one
+            # kernel pass. Duck-typed so the plain jit step, the amp
+            # step and the comm step all pick it up through this seam.
+            new_params, new_opt_state = self.tx.fused_apply(
+                grads, self.opt_state, self.params)
+        else:
+            updates, new_opt_state = self.tx.update(grads,
+                                                    self.opt_state,
+                                                    self.params)
+            new_params = optax.apply_updates(self.params, updates)
         return self.replace(step=self.step + 1, params=new_params,
                             opt_state=new_opt_state, **kwargs)
 

@@ -105,6 +105,12 @@ ENV_VARS: dict[str, str] = {
                               "(0 = XLA-partitioned single reduction)",
     "EDL_TPU_DCN_COMPRESS": "cross-slice gradient wire format: "
                             "off | topk | int8 (loss-parity gated)",
+    "EDL_TPU_FUSED_OPT": "fused optimizer path: off | fp32 | int8 | fp8 "
+                         "(train/fused_opt.py; fp32 is bitwise vs optax, "
+                         "int8/fp8 quantize resident moments)",
+    "EDL_TPU_OPT_QUANT": "override the resident-moment codec of the "
+                         "fused optimizer: off | int8 | fp8 (defaults "
+                         "to what EDL_TPU_FUSED_OPT implies)",
     "EDL_TPU_DISTILL_NOP": "distill reader no-op mode (wire debugging)",
     # -- logging / profiling ------------------------------------------------
     "EDL_TPU_LOG_DIR": "launcher workerlog directory",

@@ -1,0 +1,157 @@
+"""Fused-optimizer tuner: optimizer x impl x size sweep.
+
+Local sizing companion to the fused optimizer path
+(edl_tpu/train/fused_opt.py, doc/design_step.md): one seeded parameter
+world per size, stepped through every {sgdm, adam} x {xla (the optax
+chain), fused-fp32, fused-int8} combination, printed as a markdown
+table of
+
+  update ms/step | resident opt-state bytes | bytes vs xla | parity
+
+Seeded-exact: params, grads and the bucket plan are functions of
+--seed, so every non-timing column is stable across runs on the same
+machine. On the CPU harness the fused columns time the jitted XLA
+fallback expression (the Pallas kernel is a TPU/interpret path), so
+ms columns calibrate schedule cost, not a VMEM win; the bytes and
+parity columns are exact either way. Parity = fused-fp32 params
+bitwise vs the optax chain after --steps steps (sgdm; adam to float
+tolerance), the same gate CI pins via
+`python -m edl_tpu.train.fused_opt smoke`.
+
+  python tools/opt_bench.py --sizes 0.5,2 --steps 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/opt_bench.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
+
+
+def build_world(seed: int, size_m: float):
+    """A ragged ~size_m-million-param fp32 tree + matching grads."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    n = int(size_m * 1e6)
+    # a few big kernels + odd-sized tails so bucketing/padding engage
+    shapes = []
+    per = max(n // 4, 1)
+    cols = 1024
+    while n > 0:
+        rows = max(min(per, n) // cols, 1)
+        shapes.append((rows, cols))
+        n -= rows * cols
+    shapes += [(129,), (33,)]
+
+    def leaf(shape):
+        return jnp.asarray(rng.normal(0, 0.02, size=shape)
+                           .astype(np.float32))
+
+    params = {f"w{i}": leaf(s) for i, s in enumerate(shapes)}
+    grads = {k: leaf(v.shape) for k, v in params.items()}
+    return params, grads
+
+
+def make_tx(optimizer: str, impl: str, lr: float):
+    import optax
+
+    from edl_tpu.train import fused_opt as fo
+
+    if impl == "xla":
+        if optimizer == "sgdm":
+            return optax.chain(optax.add_decayed_weights(1e-4),
+                               optax.sgd(lr, momentum=0.9))
+        return optax.adamw(lr, weight_decay=1e-4)
+    mode = {"fused-fp32": "fp32", "fused-int8": "int8"}[impl]
+    return fo.make_fused_tx(optimizer, lr, mode, weight_decay=1e-4)
+
+
+def run_combo(optimizer: str, impl: str, params, grads, steps: int,
+              lr: float):
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.train import fused_opt as fo
+    from edl_tpu.train.state import TrainState
+
+    tx = make_tx(optimizer, impl, lr)
+    # own copy: the donated step consumes its state buffers, and the
+    # caller reuses `params` across combos
+    state = TrainState.create(apply_fn=None,
+                              params=jax.tree.map(jnp.copy, params),
+                              tx=tx)
+    step = jax.jit(lambda s, g: s.apply_gradients(grads=g),
+                   donate_argnums=(0,))
+    state = step(state, grads)  # compile + warm
+    jax.block_until_ready(jax.tree.leaves(state))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state = step(state, grads)
+    jax.block_until_ready(jax.tree.leaves(state))
+    ms = (time.perf_counter() - t0) / steps * 1e3
+    return ms, fo.opt_state_bytes(state.opt_state), state.params
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tools/opt_bench.py")
+    parser.add_argument("--sizes", default="0.5,2",
+                        help="comma list of model sizes, millions of "
+                             "params")
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--lr", type=float, default=0.1)
+    args = parser.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    sizes = [float(s) for s in args.sizes.split(",") if s]
+    impls = ("xla", "fused-fp32", "fused-int8")
+    print(f"devices={len(jax.devices())} "
+          f"backend={jax.default_backend()} seed={args.seed} "
+          f"steps={args.steps}")
+    print("| size | optimizer | impl | update ms | opt bytes "
+          "| bytes vs xla | parity |")
+    print("|---|---|---|---|---|---|---|")
+    ok = True
+    for size in sizes:
+        params, grads = build_world(args.seed, size)
+        for optimizer in ("sgdm", "adam"):
+            base_bytes = None
+            ref_params = None
+            for impl in impls:
+                ms, nbytes, out = run_combo(optimizer, impl, params,
+                                            grads, args.steps, args.lr)
+                if impl == "xla":
+                    base_bytes, ref_params = nbytes, out
+                    cut, parity = "1.00x", "ref"
+                else:
+                    cut = f"{base_bytes / nbytes:.2f}x"
+                    err = max(float(jnp.max(jnp.abs(a - b)))
+                              for a, b in zip(jax.tree.leaves(ref_params),
+                                              jax.tree.leaves(out)))
+                    if impl == "fused-fp32":
+                        # sgdm is bitwise; adam float-tolerance
+                        tol = 0.0 if optimizer == "sgdm" else 1e-4
+                        good = err <= tol
+                    else:
+                        good = np.isfinite(err)  # quantized: smoke
+                        # gate owns the loss envelope, not a param pin
+                    ok = ok and good
+                    parity = (f"err={err:.1e}"
+                              + ("" if good else " FAIL"))
+                print(f"| {size}M | {optimizer} | {impl} "
+                      f"| {ms:.2f} | {nbytes} | {cut} | {parity} |")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
