@@ -1620,6 +1620,73 @@ def bench_serving_slo(on_tpu: bool) -> dict:
         "serving_per_trace": per_trace}
 
 
+def bench_serving_throughput(on_tpu: bool) -> dict:
+    """Continuous batching + admission control on REAL TeacherServers
+    (r23): the open-loop generator (`edl_tpu.distill.loadgen`) drives
+    a sleepy fake chip, so these are scheduling numbers — the window
+    Batcher's coalesce delay vs continuous admission at equal offered
+    load, and per-class shedding under 2x overload with the delay-
+    budget rule armed. `elastic_demo --serve-load` gates the same
+    scenario in CI; this keeps the numbers on the scoreboard."""
+    import time as _time
+
+    from edl_tpu.distill.admission import AdmissionConfig
+    from edl_tpu.distill.loadgen import run_open_loop
+    from edl_tpu.distill.teacher_server import TeacherServer
+    del on_tpu  # host-side serving plane: the chip is a sleep()
+
+    def sleepy(per_row_s, base_s):
+        def predict(feeds):
+            rows = next(iter(feeds.values())).shape[0]
+            _time.sleep(base_s + per_row_s * rows)
+            return {"logits": np.zeros((rows, 4), np.float32)}
+        return predict
+
+    # A/B at mid load (half of one teacher's ~3k rows/s capacity)
+    p95 = {}
+    rps_sustained = {}
+    for mode in ("window", "continuous"):
+        server = TeacherServer(
+            sleepy(0.0003, 0.001), port=0, host="127.0.0.1",
+            max_batch=64, max_wait=0.02,
+            admission=AdmissionConfig(batching=mode)).start()
+        try:
+            s = run_open_loop([f"127.0.0.1:{server.port}"],
+                              duration_s=5.0, rps=100.0, rows=4,
+                              seed=11).summary()
+        finally:
+            server.stop()
+        p95[mode] = s["p95_ms"]
+        rps_sustained[mode] = s["rps_sustained"]
+
+    # 2x overload on 2 continuous teachers, shed rule armed: shedding
+    # must concentrate on the low class (the per-class degradation
+    # contract the CI dryrun asserts)
+    adm = AdmissionConfig(batching="continuous", shed_ms=150.0)
+    servers = [TeacherServer(sleepy(0.004, 0.004), port=0,
+                             host="127.0.0.1", max_batch=8,
+                             admission=adm).start() for _ in range(2)]
+    try:
+        over = run_open_loop(
+            [f"127.0.0.1:{s.port}" for s in servers], duration_s=10.0,
+            rps=111.0, rows=8,
+            mix={"high": 0.1, "normal": 0.15, "low": 0.75},
+            seed=12).summary()
+    finally:
+        for server in servers:
+            server.stop()
+    return {
+        "serving_p95_ms_window": round(p95["window"], 2),
+        "serving_p95_ms_continuous": round(p95["continuous"], 2),
+        "serving_p95_window_vs_continuous_x": round(
+            p95["window"] / max(p95["continuous"], 1e-9), 2),
+        "serving_rps_sustained": rps_sustained["continuous"],
+        "serving_overload_rps_sustained": over["rps_sustained"],
+        "serving_shed_pct_by_class": {
+            cls: c["shed_pct"]
+            for cls, c in over["by_class"].items()}}
+
+
 def bench_control_plane(on_tpu: bool) -> dict:
     """Event-driven control plane (ISSUE 8): watch streams vs polling.
 
@@ -2039,6 +2106,7 @@ def main() -> None:
             / p2p["elastic_downtime_p2p_s"], 2)
     scaler = bench_scaler(on_tpu)
     serving_slo = bench_serving_slo(on_tpu)
+    serving_throughput = bench_serving_throughput(on_tpu)
     control_plane = bench_control_plane(on_tpu)
     store_ha = bench_store_ha(on_tpu)
     chaos = bench_chaos(on_tpu)
@@ -2204,6 +2272,12 @@ def main() -> None:
             # ticks to restore the latency SLO after a 4x load step,
             # worst-trace attainment %, resizes paid (scaler/serving)
             **serving_slo,
+            # teacher-pool serving tier under the open-loop generator:
+            # window vs continuous batching p95 at equal sustained rps,
+            # and per-class shed % under 2x overload with the delay-
+            # budget rule armed (tools/serve_load_bench.py has the
+            # full rate sweep)
+            **serving_throughput,
             # event-driven control plane: PUT -> watcher-callback
             # latency over TCP, idle store request volume poll- vs
             # watch-mode (same consumer set), and the scaler's

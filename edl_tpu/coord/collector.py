@@ -350,11 +350,23 @@ class Collector:
         member's tail, and a conservative read can only over-provision,
         never silently violate the SLO. ``reporting`` counts teachers
         whose registrar published a parseable info doc: ``n_teachers``
-        without ``reporting`` means a pool that is up but blind."""
+        without ``reporting`` means a pool that is up but blind.
+
+        Admission-control signals (r23 registrars) roll up alongside:
+        ``shed_per_sec`` SUMS (pool-wide rejection pressure — the
+        policy's shed-blinded-breach input: an admission-controlled
+        pool keeps its p95 in-SLO *by rejecting*, so latency alone
+        under-reports overload), ``queue_depth_by_class`` sums per
+        class, and ``latency_ms_p95_by_class`` takes the worst teacher
+        per class (graceful degradation is judged per class, not
+        globally). ``draining`` counts teachers mid-drain."""
         rows, depth, inflight = 0.0, 0, 0
+        shed, draining = 0.0, 0
         utils: list[float] = []
         p50s: list[float] = []
         p95s: list[float] = []
+        depth_by_class: dict[str, int] = {}
+        p95_by_class: dict[str, float] = {}
         members = self._service_snapshot(service)
         reporting = 0
         for m in members:
@@ -365,12 +377,30 @@ class Collector:
             rows += float(info.get("rows_per_sec") or 0.0)
             depth += int(info.get("queue_depth") or 0)
             inflight += int(info.get("inflight_groups") or 0)
+            shed += float(info.get("shed_per_sec") or 0.0)
+            draining += 1 if info.get("draining") else 0
             if info.get("util") is not None:
                 utils.append(float(info["util"]))
             if info.get("latency_ms_p50") is not None:
                 p50s.append(float(info["latency_ms_p50"]))
             if info.get("latency_ms_p95") is not None:
                 p95s.append(float(info["latency_ms_p95"]))
+            split = info.get("queue_depth_by_class")
+            if isinstance(split, dict):
+                for cls, n in split.items():
+                    try:
+                        depth_by_class[str(cls)] = (
+                            depth_by_class.get(str(cls), 0) + int(n))
+                    except (TypeError, ValueError):
+                        pass
+            lat_split = info.get("latency_ms_p95_by_class")
+            if isinstance(lat_split, dict):
+                for cls, p95 in lat_split.items():
+                    try:
+                        p95_by_class[str(cls)] = max(
+                            p95_by_class.get(str(cls), 0.0), float(p95))
+                    except (TypeError, ValueError):
+                        pass
         return {"service": service,
                 "n_teachers": len(members),
                 "reporting": reporting,
@@ -380,7 +410,11 @@ class Collector:
                 "queue_depth": depth,
                 "inflight_groups": inflight,
                 "latency_ms_p50": max(p50s) if p50s else None,
-                "latency_ms_p95": max(p95s) if p95s else None}
+                "latency_ms_p95": max(p95s) if p95s else None,
+                "shed_per_sec": round(shed, 2),
+                "queue_depth_by_class": depth_by_class,
+                "latency_ms_p95_by_class": p95_by_class,
+                "draining": draining}
 
     def snapshot(self) -> dict:
         records, revision = self.store.get_prefix("")

@@ -25,11 +25,13 @@ Invariants (property-tested in tests/test_balance.py):
   I6. utilization is a TIE-BREAK only: among servers with equal link
       counts the least-busy is preferred — the busy score blends the
       registrar-reported ``util`` with ``queue_depth`` (each queued
-      request adds ``QUEUE_WEIGHT``), so a backlogged teacher sheds new
-      clients before it violates the latency SLO and the idle S mod C
-      servers of an under-subscribed service are the busiest ones —
-      I1-I4 are unaffected by construction (the link count stays the
-      primary key).
+      request adds ``QUEUE_WEIGHT``; with a per-class depth split the
+      class-specific ``CLASS_QUEUE_WEIGHT`` applies instead, so queued
+      HIGH-priority work repels new links hardest), so a backlogged
+      teacher sheds new clients before it violates the latency SLO and
+      the idle S mod C servers of an under-subscribed service are the
+      busiest ones — I1-I4 are unaffected by construction (the link
+      count stays the primary key).
 
 Unlike the reference this is a standalone, lock-free-by-construction value
 type: the discovery server owns one instance per service and serializes
@@ -66,6 +68,12 @@ class ServiceBalance:
     # running flat-out with an empty queue — backlog is the leading
     # indicator of an SLO violation, utilization only the trailing one.
     QUEUE_WEIGHT = 0.2
+    # With a per-priority-class depth split (r23 registrars), the same
+    # backlog weighs by CLASS: queued high-priority work pressures the
+    # tie-break hardest (that backlog is about to breach an SLO), queued
+    # low-priority work barely at all (it sheds first under overload
+    # anyway). Unknown classes fall back to QUEUE_WEIGHT.
+    CLASS_QUEUE_WEIGHT = {"high": 0.4, "normal": 0.2, "low": 0.05}
 
     def __init__(self, name: str):
         self.name = name
@@ -80,21 +88,37 @@ class ServiceBalance:
         # blended into the same tie-break: a backlogged teacher sheds
         # NEW clients before it violates the latency SLO
         self.queue_depth: dict[str, int] = {}
+        # per-class split of the same backlog (registrar
+        # `queue_depth_by_class`): preferred over the flat depth when
+        # present
+        self.queue_depth_by_class: dict[str, dict[str, int]] = {}
 
     def set_utilization(self, util: dict[str, float],
-                        queue_depth: dict[str, int] | None = None) -> None:
+                        queue_depth: dict[str, int] | None = None,
+                        queue_depth_by_class:
+                        dict[str, dict[str, int]] | None = None) -> None:
         self.utilization = dict(util)
         if queue_depth is not None:
             self.queue_depth = dict(queue_depth)
+        if queue_depth_by_class is not None:
+            self.queue_depth_by_class = dict(queue_depth_by_class)
 
     def _busy(self, server: str) -> float:
         # Unknown load is NEUTRAL (0.5), not idle: a non-reporting
         # teacher must not systematically win ties against one honestly
         # reporting a small util — it could be saturated for all we know.
         # Queue depth rides on top (unknown = 0: absence of a backlog
-        # report must not outweigh a reported idle queue).
-        return (self.utilization.get(server, 0.5)
-                + self.QUEUE_WEIGHT * self.queue_depth.get(server, 0))
+        # report must not outweigh a reported idle queue). A by-class
+        # split, when reported, replaces the flat term with the
+        # class-weighted one.
+        by_class = self.queue_depth_by_class.get(server)
+        if by_class:
+            depth_term = sum(
+                self.CLASS_QUEUE_WEIGHT.get(cls, self.QUEUE_WEIGHT) * n
+                for cls, n in by_class.items())
+        else:
+            depth_term = self.QUEUE_WEIGHT * self.queue_depth.get(server, 0)
+        return self.utilization.get(server, 0.5) + depth_term
 
     # -- membership --------------------------------------------------------
 

@@ -140,13 +140,16 @@ class BalanceTable:
     # -- tick ---------------------------------------------------------------
 
     @staticmethod
-    def _busy_scores(metas) -> tuple[dict[str, float], dict[str, int]]:
-        """Registrar-published busy fractions (`util`) and intake
-        backlogs (`queue_depth`) from the info JSON — the balancer's
-        blended tie-break (balance.py invariant I6). Either field may be
+    def _busy_scores(metas) -> tuple[dict[str, float], dict[str, int],
+                                     dict[str, dict[str, int]]]:
+        """Registrar-published busy fractions (`util`), intake backlogs
+        (`queue_depth`) and their per-priority-class split
+        (`queue_depth_by_class`) from the info JSON — the balancer's
+        blended tie-break (balance.py invariant I6). Any field may be
         missing independently (old-format registrars)."""
         scores: dict[str, float] = {}
         depths: dict[str, int] = {}
+        by_class: dict[str, dict[str, int]] = {}
         for m in metas:
             try:
                 doc = json.loads(m.info)
@@ -162,7 +165,14 @@ class BalanceTable:
                 depths[m.server] = int(doc["queue_depth"])
             except (KeyError, TypeError, ValueError):
                 pass
-        return scores, depths
+            split = doc.get("queue_depth_by_class")
+            if isinstance(split, dict):
+                try:
+                    by_class[m.server] = {str(c): int(n)
+                                          for c, n in split.items()}
+                except (TypeError, ValueError):
+                    pass
+        return scores, depths, by_class
 
     def tick(self) -> None:
         """Refresh teacher membership, expire silent clients, rebalance."""

@@ -46,8 +46,9 @@ from typing import Callable, Iterable, Iterator
 
 import numpy as np
 
-from edl_tpu.distill.teacher_server import TeacherClient
+from edl_tpu.distill.teacher_server import TeacherClient, TeacherRejected
 from edl_tpu.utils import config
+from edl_tpu.utils.backoff import Backoff
 from edl_tpu.utils.exceptions import EdlError
 from edl_tpu.utils.logging import get_logger
 from edl_tpu.utils.timeline import timeline
@@ -67,6 +68,10 @@ class Task:
     feeds: dict
     rows: int
     retries: int = 0
+    # admission-shed rejections are accounted SEPARATELY from
+    # connection-death retries: a shed is the pool saying "later", not
+    # a fault, so it gets its own (larger) bounded budget
+    shed_retries: int = 0
 
 
 @dataclass
@@ -156,6 +161,9 @@ class _PredictWorker(threading.Thread):
         depth = (p.pipeline_depth
                  if hasattr(client, "predict_async") else 1)
         inflight: deque = deque()   # [(task, handle-or-None)] send order
+        # worker-owned (Backoff is not thread-safe by design); reset on
+        # every successful serve so only CONSECUTIVE sheds escalate
+        shed_backoff = Backoff(base=0.05, factor=2.0, max_delay=1.0)
 
         def die(exc: Exception, task: Task) -> None:
             """Connection-level failure: every in-flight task on this
@@ -203,9 +211,32 @@ class _PredictWorker(threading.Thread):
                     with tl.span("predict"):
                         outs = (handle.result() if handle is not None
                                 else client.predict(task.feeds))
+                except TeacherRejected as rej:
+                    # Typed admission shed — the connection is FINE; the
+                    # teacher answered "come back later". Re-queue the
+                    # task (a less-loaded teacher may take it) behind a
+                    # jittered backoff floored at the server's
+                    # retry_after hint, bounded by its own budget so a
+                    # permanently-shedding pool fails typed instead of
+                    # spinning forever. Never surfaces to the training
+                    # step unless the budget is exhausted.
+                    inflight.popleft()
+                    task.shed_retries += 1
+                    if task.shed_retries > p.shed_retry_budget:
+                        p.fail(f"teacher pool shedding: task "
+                               f"{task.task_id} rejected "
+                               f"{task.shed_retries} times (budget "
+                               f"{p.shed_retry_budget}): {rej}")
+                        return
+                    p.in_queue.put(task)
+                    delay = max(shed_backoff.delay(), rej.retry_after_s)
+                    if self.stop_event.wait(min(delay, 2.0)):
+                        return
+                    continue
                 except Exception as exc:
                     die(exc, task)
                     return
+                shed_backoff.reset()
                 inflight.popleft()
                 reason = self._check_outs(outs)
                 if reason is not None:
@@ -227,6 +258,7 @@ class _EpochPipeline:
     def __init__(self, reader: "DistillReader"):
         self.predicts = reader._wire_predicts
         self.max_retries = reader.max_retries
+        self.shed_retry_budget = reader.shed_retry_budget
         self.client_factory = reader._client_factory
         self.pipeline_depth = reader.pipeline_depth
         self.compress_topk = reader.compress_topk
@@ -328,6 +360,12 @@ class DistillReader:
         ``name.idx``/``name.val`` pairs for sparse-aware losses
         (train/classification.make_sparse_distill_step). Dict format
         only.
+      shed_retry_budget: bounded retries per task on teacher admission
+        sheds (typed retry-after responses); past it the epoch raises
+        EdlDistillError. Default EDL_TPU_SERVE_RETRY_BUDGET (8).
+      tenant / priority: multi-tenant identity attached to every
+        predict request — the teacher pool queues/sheds per (tenant,
+        priority class); see distill/admission.py.
 
     Env: ``EDL_TPU_DISTILL_NOP=1`` swaps real connections for nop teachers
     (offline smoke; tests inject ``client_factory`` directly).
@@ -346,7 +384,9 @@ class DistillReader:
                  pipeline_depth: int = 4,
                  compress_topk: int = 0,
                  compress_values: str = "float16",
-                 sparse_predicts: bool = False):
+                 sparse_predicts: bool = False,
+                 shed_retry_budget: int | None = None,
+                 tenant: str = "", priority: str = ""):
         self.reader = reader
         self._format = _FMT_DICT
         self._ins = list(ins) if ins is not None else None
@@ -370,6 +410,13 @@ class DistillReader:
         self.deadman_timeout = deadman_timeout
         self.pipeline_depth = max(1, int(pipeline_depth))
         self.compress_topk = int(compress_topk)
+        # bounded budget for admission-shed retries per task (satellite
+        # of the r23 serving tier): sheds requeue behind a jittered
+        # backoff, and past the budget the epoch fails TYPED instead of
+        # retrying forever against a permanently-overloaded pool
+        self.shed_retry_budget = (
+            shed_retry_budget if shed_retry_budget is not None
+            else config.env_int("EDL_TPU_SERVE_RETRY_BUDGET", 8))
         self._fixed_teachers = list(teachers) if teachers else None
         self._discovery_endpoints = discovery
         self._service = service
@@ -385,7 +432,8 @@ class DistillReader:
                     ep, timeout=rpc_timeout, compress_topk=compress_topk,
                     compress_values=compress_values,
                     expand=not sparse_predicts,
-                    max_inflight=self.pipeline_depth)
+                    max_inflight=self.pipeline_depth,
+                    tenant=tenant, priority=priority)
         self._client_factory = client_factory
 
     # -- teacher set --------------------------------------------------------

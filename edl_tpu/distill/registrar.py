@@ -120,6 +120,23 @@ class TeacherRegistrar:
         # same windowed-vs-cumulative contract the regression tests pin.
         d_lat = Histogram.window(cur.get("latency_hist_ms", {}),
                                  (prev or {}).get("latency_hist_ms", {}))
+        # per-priority-class split of the same windowed signal (r23):
+        # graceful degradation must be visible PER CLASS — a pool
+        # shedding low while holding high's p95 looks healthy globally
+        prev_by_cls = (prev or {}).get("latency_hist_ms_by_class", {})
+        p95_by_class = {}
+        for cls, hist in (cur.get("latency_hist_ms_by_class") or {}).items():
+            p95 = latency_quantile(
+                Histogram.window(hist, prev_by_cls.get(cls, {})), 0.95)
+            if p95 is not None:
+                p95_by_class[cls] = p95
+        d_shed = (cur.get("rejected_total", 0)
+                  - (prev or {}).get("rejected_total", 0))
+        prev_rej = (prev or {}).get("rejected_by_class", {})
+        shed_by_class = {
+            cls: n - prev_rej.get(cls, 0)
+            for cls, n in (cur.get("rejected_by_class") or {}).items()
+            if n - prev_rej.get(cls, 0) > 0}
         return json.dumps({
             "rows_per_sec": round(d_rows / max(dt, 1e-9), 1),
             "util": round(min(1.0, d_busy / max(dt, 1e-9)), 3),
@@ -129,6 +146,11 @@ class TeacherRegistrar:
             else 0.0,
             "latency_ms_p50": latency_quantile(d_lat, 0.5),
             "latency_ms_p95": latency_quantile(d_lat, 0.95),
+            "queue_depth_by_class": cur.get("queue_depth_by_class") or {},
+            "latency_ms_p95_by_class": p95_by_class,
+            "shed_per_sec": round(d_shed / max(dt, 1e-9), 2),
+            "shed_by_class": shed_by_class,
+            "draining": int(cur.get("draining", 0)),
         }, sort_keys=True)
 
     def _stats_loop(self) -> None:

@@ -54,6 +54,9 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from edl_tpu.data import tensor_wire
+from edl_tpu.distill.admission import (PRIORITIES, AdmissionConfig,
+                                       AdmissionQueue, AdmissionReject,
+                                       normalize_priority)
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils.logging import get_logger
 
@@ -94,6 +97,8 @@ class _Request:
     done: threading.Event = field(default_factory=threading.Event)
     result: dict[str, np.ndarray] | None = None
     error: str | None = None
+    tenant: str = "default"
+    cls: str = "normal"   # priority class (admission.PRIORITIES)
     # submit time: the latency histogram measures submit -> results
     # ready (coalesce wait + device compute + host fetch) — what a
     # pipelined client experiences per request, the serving SLO signal
@@ -119,27 +124,46 @@ class Batcher:
     (De)serialization and `compress_outputs` run on the per-connection
     handler/writer threads (see `_Handler`), never here.
 
-    Adaptive coalescing window: a group closes after ``max_wait`` ONLY
-    when the device pipeline is idle (dispatching early actually starts
-    work). While a previous group is still in flight the window extends
-    up to ``max_wait_cap`` — waiting costs nothing then, the chip could
-    not take the group anyway — so pipelined clients coalesce toward
-    ``max_batch`` rows under steady load without ever inserting an idle
-    bubble under light load.
+    Batching modes (r23, ``EDL_TPU_SERVE_BATCHING``):
+
+    ``continuous`` (default) — iteration-level admission, no timed
+    window. A group dispatches the moment the pipeline can take it
+    (idle-device latency is one queue hop), and while the pipeline is
+    full the forming group keeps ADMITTING newly-arrived requests up to
+    ``max_batch`` rows — each device step starts from everything that
+    arrived during the previous one, the Orca/vLLM scheduling shape.
+    ``max_wait`` is unused; ``max_wait_cap`` only bounds how long one
+    group may keep forming against a saturated pipeline.
+
+    ``window`` — the r6 adaptive coalescing window, kept for A/B
+    benches: a group closes after ``max_wait`` ONLY when the device
+    pipeline is idle, extending up to ``max_wait_cap`` while a previous
+    group is in flight.
+
+    Intake is an `AdmissionQueue` (bounded multi-tenant WFQ): submits
+    may raise `AdmissionReject`, which the wire handler answers with a
+    typed retry-after response instead of queuing toward a collapsed
+    p95. See edl_tpu/distill/admission.py.
     """
 
     def __init__(self, predict_fn, *, max_batch: int = 64,
                  max_wait: float = 0.002,
                  buckets: tuple[int, ...] = DEFAULT_BUCKETS,
                  max_wait_cap: float | None = None,
-                 stage_depth: int = 2):
+                 stage_depth: int = 2,
+                 batching: str | None = None,
+                 admission: AdmissionConfig | None = None):
         self.predict_fn = predict_fn
         self.max_batch = max_batch
         self.max_wait = max_wait
         self.max_wait_cap = (max_wait_cap if max_wait_cap is not None
                              else max(8 * max_wait, 0.016))
         self.buckets = tuple(sorted(buckets))
-        self._q: queue.Queue[_Request | None] = queue.Queue()
+        self.admission_config = admission or AdmissionConfig.from_env()
+        self.batching = batching or self.admission_config.batching
+        if self.batching not in ("continuous", "window"):
+            raise ValueError(f"unknown batching mode {self.batching!r}")
+        self._q = AdmissionQueue(self.admission_config)
         # bounded stage queues: coalesce may run at most `stage_depth`
         # groups ahead of the chip, the chip at most `stage_depth` ahead
         # of the host fetch
@@ -187,32 +211,103 @@ class Batcher:
         # coherent). inf = overflow.
         self._lat_hist = obs_metrics.Histogram(
             LATENCY_BUCKETS_MS)         # guarded-by: _stats_lock
+        # per-priority-class split of the same signal: the registrar
+        # differences these into windowed per-class p95 so graceful
+        # degradation is observable PER CLASS, not globally
+        self._lat_hist_by_class = {
+            c: obs_metrics.Histogram(LATENCY_BUCKETS_MS)
+            for c in PRIORITIES}        # guarded-by: _stats_lock
 
     def start(self) -> "Batcher":
         for t in self._threads:
             t.start()
         return self
 
-    def submit(self, tensors: dict[str, np.ndarray]) -> _Request:
+    def submit(self, tensors: dict[str, np.ndarray], *,
+               tenant: str = "default", priority: str = "normal"
+               ) -> _Request:
+        """Admit one predict request. Raises `AdmissionReject` when the
+        tenant's queue is full, the class's delay budget is blown, or
+        the batcher is draining — the caller answers with a typed
+        retry-after instead of queueing."""
         rows = next(iter(tensors.values())).shape[0] if tensors else 0
-        req = _Request(tensors=tensors, rows=rows)
-        depth = self._q.qsize() + 1
+        req = _Request(tensors=tensors, rows=rows, tenant=tenant or
+                       "default", cls=normalize_priority(priority))
+        self._q.submit(req, rows, req.tenant, req.cls)
+        depth = self._q.qsize()
         if depth > self._pending_hwm:
             with self._stats_lock:
                 self._pending_hwm = max(self._pending_hwm, depth)
-        self._q.put(req)
         return req
 
+    def begin_drain(self) -> None:
+        """Stop admitting (every new submit rejects with retry-after)
+        while already-admitted work completes normally — the graceful
+        half of the scaler's drain protocol."""
+        self._q.begin_drain()
+
+    def _join(self, group: list[_Request], names: list[str], rows: int,
+              req: _Request | None) -> tuple[int, bool]:
+        """Try to add ``req`` to the forming group; heterogeneous feeds
+        or row overflow OPEN the next group via carry (order
+        preserved). Returns (rows, keep_collecting)."""
+        if req is None:
+            return rows, True
+        if list(req.tensors) != names or rows + req.rows > self.max_batch:
+            self._carry = req
+            return rows, False
+        group.append(req)
+        return rows + req.rows, True
+
     def _collect(self) -> list[_Request]:
-        """One blocking pop, then drain whatever arrives within the
-        adaptive window (bounded by max_batch rows)."""
+        if self.batching == "continuous":
+            return self._collect_continuous()
+        return self._collect_window()
+
+    def _collect_continuous(self) -> list[_Request]:
+        """Iteration-level admission: dispatch as soon as the pipeline
+        has room, and while it has none keep admitting arrivals into
+        the forming group — each device step starts from everything
+        that arrived during the last one."""
         first = self._carry
         self._carry = None
         if first is None:
-            try:
-                first = self._q.get(timeout=0.2)
-            except queue.Empty:
+            first = self._q.get(timeout=0.2)
+            if first is None:
                 return []
+        t_first = time.monotonic()
+        hard = t_first + self.max_wait_cap
+        names = list(first.tensors)
+        group, rows = [first], first.rows
+        while rows < self.max_batch:
+            req = self._q.get_nowait()
+            if req is not None:
+                rows, more = self._join(group, names, rows, req)
+                if not more:
+                    break
+                continue
+            # intake empty: dispatch now unless the pipeline is full —
+            # then the chip could not take the group anyway, so keep
+            # admitting until a slot frees (bounded by max_wait_cap)
+            if not self._compute_q.full() or self._stop.is_set() \
+                    or time.monotonic() >= hard:
+                break
+            req = self._q.get(timeout=0.001)
+            rows, more = self._join(group, names, rows, req)
+            if not more:
+                break
+        window = time.monotonic() - t_first
+        with self._stats_lock:
+            self._window_ema_s += 0.2 * (window - self._window_ema_s)
+        return group
+
+    def _collect_window(self) -> list[_Request]:
+        """r6 behavior: one blocking pop, then drain whatever arrives
+        within the adaptive window (bounded by max_batch rows)."""
+        first = self._carry
+        self._carry = None
+        if first is None:
+            first = self._q.get(timeout=0.2)
             if first is None:
                 return []
         t_first = time.monotonic()
@@ -231,20 +326,14 @@ class Batcher:
             # device busy: the chip can't take this group yet, so keep
             # coalescing (1 ms polls re-check the busy signal)
             timeout = min((hard if busy else soft) - now, 0.001)
-            try:
-                req = self._q.get(timeout=max(timeout, 0.0))
-            except queue.Empty:
-                continue
+            req = self._q.get(timeout=max(timeout, 0.0))
             if req is None:
+                if self._stop.is_set():
+                    break
+                continue
+            rows, more = self._join(group, names, rows, req)
+            if not more:
                 break
-            if list(req.tensors) != names \
-                    or rows + req.rows > self.max_batch:
-                # Heterogeneous feeds can't coalesce / doesn't fit this
-                # round: it OPENS the next group (order preserved).
-                self._carry = req
-                break
-            group.append(req)
-            rows += req.rows
         window = time.monotonic() - t_first
         with self._stats_lock:
             self._window_ema_s += 0.2 * (window - self._window_ema_s)
@@ -327,8 +416,13 @@ class Batcher:
                 self._served_requests += len(group)
                 self._batch_hist[rows] = self._batch_hist.get(rows, 0) + 1
                 for req in group:
-                    self._lat_hist.observe((now - req.t_submit) * 1e3)
+                    lat_ms = (now - req.t_submit) * 1e3
+                    self._lat_hist.observe(lat_ms)
+                    self._lat_hist_by_class[req.cls].observe(lat_ms)
                 self._groups_inflight -= 1
+            # feed the admission plane's service-rate estimate (its own
+            # leaf lock; never taken with _stats_lock held)
+            self._q.note_served(rows)
             offset = 0
             for req in group:
                 req.result = {k: v[offset:offset + req.rows]
@@ -337,35 +431,56 @@ class Batcher:
                 req.done.set()
 
     def stats(self) -> dict:
-        """Cumulative serving counters (consumed by TeacherRegistrar)."""
+        """Cumulative serving counters (consumed by TeacherRegistrar).
+
+        The un-suffixed keys are a PINNED contract (the r15 autoscaler
+        and drain poller consume queue_depth / inflight_groups / the
+        latency quantiles; tests/test_serving_continuous.py pins the
+        schema). ``*_by_class`` / ``*_by_tenant`` keys are one-level
+        dicts the obs plane renders as labeled gauges."""
+        # admission snapshot first (its own leaf lock — the two locks
+        # are never nested, in either order)
+        adm = self._q.stats()
         with self._stats_lock:
             hist = dict(sorted(self._batch_hist.items()))
             groups = sum(hist.values())
             rows_mean = (sum(r * c for r, c in hist.items()) / groups
                          if groups else 0.0)
             lat = self._lat_hist.snapshot()  # ascending edges, inf last
-            return {"served_rows": self._served_rows,
-                    "served_requests": self._served_requests,
-                    "busy_s": round(self._busy_s, 4),
-                    "uptime_s": round(time.monotonic() - self._started_at, 4),
-                    "queue_depth": self._q.qsize(),
-                    # groups past intake (queued/computing/fetching): with
-                    # queue_depth == 0 this is the whole "work still in
-                    # flight" signal a draining pool waits out
-                    "inflight_groups": self._groups_inflight,
-                    "pending_hwm": self._pending_hwm,
-                    "coalesce_window_ms": round(self._window_ema_s * 1e3,
-                                                3),
-                    # JSON object keys are strings on the wire
-                    "batch_rows_hist": {str(r): c for r, c in hist.items()},
-                    "batch_rows_mean": round(rows_mean, 2),
-                    "latency_hist_ms": {str(b): c for b, c in lat.items()},
-                    "latency_ms_p50": latency_quantile(lat, 0.5),
-                    "latency_ms_p95": latency_quantile(lat, 0.95)}
+            lat_by_class = {c: h.snapshot()
+                            for c, h in self._lat_hist_by_class.items()}
+            out = {"served_rows": self._served_rows,
+                   "served_requests": self._served_requests,
+                   "busy_s": round(self._busy_s, 4),
+                   "uptime_s": round(time.monotonic() - self._started_at, 4),
+                   "queue_depth": self._q.qsize(),
+                   # groups past intake (queued/computing/fetching): with
+                   # queue_depth == 0 this is the whole "work still in
+                   # flight" signal a draining pool waits out
+                   "inflight_groups": self._groups_inflight,
+                   "pending_hwm": self._pending_hwm,
+                   "batching": self.batching,
+                   "coalesce_window_ms": round(self._window_ema_s * 1e3,
+                                               3),
+                   # JSON object keys are strings on the wire
+                   "batch_rows_hist": {str(r): c for r, c in hist.items()},
+                   "batch_rows_mean": round(rows_mean, 2),
+                   "latency_hist_ms": {str(b): c for b, c in lat.items()},
+                   "latency_ms_p50": latency_quantile(lat, 0.5),
+                   "latency_ms_p95": latency_quantile(lat, 0.95)}
+        out.update(adm)
+        out["latency_hist_ms_by_class"] = {
+            c: {str(b): n for b, n in snap.items()}
+            for c, snap in lat_by_class.items()}
+        p95s = {c: latency_quantile(snap, 0.95)
+                for c, snap in lat_by_class.items()}
+        out["latency_ms_p95_by_class"] = {
+            c: v for c, v in p95s.items() if v is not None}
+        return out
 
     def stop(self) -> None:
         self._stop.set()
-        self._q.put(None)
+        self._q.close()
         for t in self._threads:
             t.join(timeout=5.0)
 
@@ -500,7 +615,22 @@ class _Handler(socketserver.BaseRequestHandler):
                                     {"ok": False,
                                      "error": "no feed tensors"}, {}))
                         continue
-                    req = batcher.submit(tensors)
+                    try:
+                        req = batcher.submit(
+                            tensors, tenant=meta.get("tenant", "default"),
+                            priority=meta.get("priority", "normal"))
+                    except AdmissionReject as rej:
+                        # typed load-shed response on the SAME open
+                        # connection — never a dropped socket: the
+                        # client backs off retry_after_ms and retries
+                        # (here or on another teacher)
+                        resp_q.put(("done", seq,
+                                    {"ok": False, "rejected": True,
+                                     "error": str(rej),
+                                     "reason": rej.reason,
+                                     "retry_after_ms": rej.retry_after_ms},
+                                    {}))
+                        continue
                     resp_q.put(("predict", seq, meta.get("compress"), req))
                 else:
                     try:
@@ -524,6 +654,12 @@ class _Handler(socketserver.BaseRequestHandler):
             return {"ok": True}, {}
         if op == "stats":
             return {"ok": True, **batcher.stats()}, {}
+        if op == "drain":
+            # graceful-shutdown handshake: stop admitting, finish
+            # in-flight work; the drain poller watches queue_depth +
+            # inflight_groups go quiet before stopping the process
+            batcher.begin_drain()
+            return {"ok": True, "draining": True}, {}
         return {"ok": False, "error": f"unknown op {op!r}"}, {}
 
     @staticmethod
@@ -578,7 +714,9 @@ class TeacherServer:
                  max_batch: int = 64, max_wait: float = 0.002,
                  buckets: tuple[int, ...] = DEFAULT_BUCKETS,
                  compressed_meta: dict[str, dict] | None = None,
-                 max_wait_cap: float | None = None):
+                 max_wait_cap: float | None = None,
+                 batching: str | None = None,
+                 admission: AdmissionConfig | None = None):
         """``compressed_meta``: announce that `predict_fn` ALREADY emits
         sparse ``name.idx``/``name.val`` outputs (device-side
         ``lax.top_k`` — only K values ever cross host<->device instead
@@ -588,7 +726,8 @@ class TeacherServer:
         consume as-is."""
         self.batcher = Batcher(predict_fn, max_batch=max_batch,
                                max_wait=max_wait, buckets=buckets,
-                               max_wait_cap=max_wait_cap)
+                               max_wait_cap=max_wait_cap,
+                               batching=batching, admission=admission)
         self.compressed_meta = dict(compressed_meta or {})
         self._server = _ThreadingServer((host, port), _Handler)
         self._server.batcher = self.batcher  # type: ignore[attr-defined]
@@ -611,6 +750,11 @@ class TeacherServer:
                          name="teacher-serve").start()
         log.info("teacher server on :%d", self.port)
         return self
+
+    def drain(self) -> None:
+        """Stop admitting new requests; in-flight work completes. The
+        in-process mirror of the wire ``op: "drain"``."""
+        self.batcher.begin_drain()
 
     def stop(self) -> None:
         self._server.shutdown()
@@ -641,6 +785,24 @@ class TeacherServer:
         self.stop()
 
 
+class TeacherRejected(tensor_wire.TensorWireError):
+    """Typed admission rejection off the wire: the teacher answered
+    ``{"ok": false, "rejected": true, "retry_after_ms": R}`` instead of
+    serving. NOT a dead connection — the socket stays usable; callers
+    back off ``retry_after_s`` (jittered) and retry, here or on another
+    teacher (reader.py's bounded shed-retry budget)."""
+
+    def __init__(self, message: str, retry_after_ms: float = 100.0,
+                 reason: str = "overload"):
+        super().__init__(message)
+        self.retry_after_ms = float(retry_after_ms)
+        self.reason = reason
+
+    @property
+    def retry_after_s(self) -> float:
+        return self.retry_after_ms / 1e3
+
+
 class _PendingPredict:
     """Handle for one in-flight request on a pipelined TeacherClient.
     ``result()`` blocks until THIS request's response arrives (receiving
@@ -666,6 +828,11 @@ class _PendingPredict:
         client's negotiation settings."""
         meta, tensors = self.response()
         if not meta.get("ok"):
+            if meta.get("rejected"):
+                raise TeacherRejected(
+                    meta.get("error", "admission rejected"),
+                    meta.get("retry_after_ms", 100.0),
+                    meta.get("reason", "overload"))
             raise tensor_wire.TensorWireError(
                 meta.get("error", "predict failed"))
         if self._client.expand:
@@ -697,12 +864,18 @@ class TeacherClient:
 
     def __init__(self, endpoint: str, timeout: float = 30.0, *,
                  compress_topk: int = 0, compress_values: str = "float16",
-                 expand: bool = True, max_inflight: int = 32):
+                 expand: bool = True, max_inflight: int = 32,
+                 tenant: str = "", priority: str = ""):
         from edl_tpu.utils.net import split_endpoint
         self.endpoint = endpoint
         self.compress_topk = int(compress_topk)
         self.compress_values = compress_values
         self.expand = expand
+        # multi-tenant identity: attached to every predict request so
+        # the teacher's admission plane can queue/shed per (tenant,
+        # priority class). Empty = the server's defaults.
+        self.tenant = tenant
+        self.priority = priority
         self.max_inflight = max(1, int(max_inflight))
         host, port = split_endpoint(endpoint)
         self._sock = socket.create_connection((host, port), timeout=timeout)
@@ -749,6 +922,10 @@ class TeacherClient:
         if self.compress_topk > 0:
             meta["compress"] = {"topk": self.compress_topk,
                                 "values": self.compress_values}
+        if self.tenant:
+            meta["tenant"] = self.tenant
+        if self.priority:
+            meta["priority"] = self.priority
         return self._submit(meta, feeds)
 
     def predict(self, feeds: dict[str, np.ndarray]
@@ -758,6 +935,14 @@ class TeacherClient:
     def ping(self) -> bool:
         try:
             meta, _ = self._submit({"op": "ping"}).response()
+            return bool(meta.get("ok"))
+        except (tensor_wire.TensorWireError, OSError):
+            return False
+
+    def drain(self) -> bool:
+        """Ask the remote teacher to stop admitting (op: drain)."""
+        try:
+            meta, _ = self._submit({"op": "drain"}).response()
             return bool(meta.get("ok"))
         except (tensor_wire.TensorWireError, OSError):
             return False
@@ -928,6 +1113,10 @@ def main(argv=None) -> int:
                              "uint8, e.g. the JPEG plane)")
     parser.add_argument("--max-batch", type=int, default=64)
     parser.add_argument("--max-wait-ms", type=float, default=2.0)
+    parser.add_argument("--batching", default="",
+                        choices=("", "continuous", "window"),
+                        help="batch admission mode (default: "
+                             "EDL_TPU_SERVE_BATCHING or continuous)")
     parser.add_argument("--serve-topk", type=int, default=0,
                         help="device-side top-k: serve only K "
                              "(idx, fp16 val) pairs per row instead of "
@@ -945,7 +1134,8 @@ def main(argv=None) -> int:
     server = TeacherServer(predict, port=args.port, host=args.host,
                            max_batch=args.max_batch,
                            max_wait=args.max_wait_ms / 1000.0,
-                           compressed_meta=compressed_meta)
+                           compressed_meta=compressed_meta,
+                           batching=args.batching or None)
     server.start()
     try:
         threading.Event().wait()

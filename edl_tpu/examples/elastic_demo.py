@@ -387,6 +387,173 @@ def run_serve_scaler_demo(args) -> int:
     return 0 if ok else 1
 
 
+def run_serve_load_demo(args) -> int:
+    """Continuous batching + admission control end-to-end on this host
+    (r23): real `TeacherServer`s with sleepy predict_fns standing in
+    for chip time, probed by the open-loop generator
+    (`edl_tpu.distill.loadgen`) — arrivals never wait on completions,
+    so overload shows up as latency/shed instead of being absorbed by
+    a self-throttling client.
+
+    Two self-audited phases:
+
+      A. **batching A/B** — one teacher per mode at the same offered
+         rates (low and mid load, well under capacity): continuous
+         must sustain the same throughput as the r6 window Batcher
+         with at least 1.5x lower p95 (the window's coalesce delay is
+         pure latency when the device is idle; continuous dispatches
+         the moment the pipeline has room).
+
+      B. **overload + chaos** — two continuous teachers with the
+         overload-shed rule armed, offered 2x pool capacity on a
+         high/normal/low mix, one teacher HARD-killed mid-phase (no
+         deregistration, no drain — the loadgen's failover path).
+         Degradation must be per class: the high class holds >= 90%
+         SLO attainment and (almost) never sheds, shedding
+         concentrates on low, and completions keep flowing after both
+         the first shed and the kill (the graceful-recovery audit).
+
+    Prints a machine-readable ``serve_load_summary=`` line and returns
+    non-zero unless every gate holds.
+    """
+    import threading
+    import time
+
+    from edl_tpu.distill.admission import AdmissionConfig
+    from edl_tpu.distill.loadgen import LoadStats, run_open_loop
+    from edl_tpu.distill.teacher_server import TeacherServer
+
+    phase_s = args.serve_phase_s
+    failures: list[str] = []
+
+    def gate(cond: bool, what: str) -> None:
+        if not cond:
+            failures.append(what)
+        log.info("%s %s", "ok  " if cond else "FAIL", what)
+
+    # -- phase A: window vs continuous at equal offered load ------------
+
+    def sleepy(per_row_s: float, base_s: float):
+        def predict(feeds):
+            rows = next(iter(feeds.values())).shape[0]
+            time.sleep(base_s + per_row_s * rows)
+            return {"logits": np.zeros((rows, 4), np.float32)}
+        return predict
+
+    ab: dict[str, dict] = {}
+    for mode in ("window", "continuous"):
+        # fast fake chip (~0.3 ms/row): service time is small against
+        # the 20 ms coalesce window, so the window's cost is visible
+        server = TeacherServer(
+            sleepy(0.0003, 0.001), port=0, host="127.0.0.1",
+            max_batch=64, max_wait=0.02,
+            admission=AdmissionConfig(batching=mode)).start()
+        runs = {}
+        try:
+            for load, rps in (("low", 25.0), ("mid", 100.0)):
+                stats = run_open_loop(
+                    [f"127.0.0.1:{server.port}"], duration_s=phase_s,
+                    rps=rps, rows=4, seed=11)
+                runs[load] = stats.summary()
+        finally:
+            server.stop()
+        ab[mode] = runs
+    for load in ("low", "mid"):
+        w, c = ab["window"][load], ab["continuous"][load]
+        gate(w["error"] == 0 and c["error"] == 0
+             and w["shed"] == 0 and c["shed"] == 0,
+             f"A/{load}: clean run (no shed, no errors)")
+        gate(abs(w["rps_sustained"] - c["rps_sustained"])
+             <= 0.15 * max(w["rps_sustained"], c["rps_sustained"]),
+             f"A/{load}: equal sustained throughput "
+             f"(window {w['rps_sustained']} vs continuous "
+             f"{c['rps_sustained']} rps)")
+        gate(c["p95_ms"] * 1.5 <= w["p95_ms"],
+             f"A/{load}: continuous p95 >=1.5x lower "
+             f"({c['p95_ms']:.1f} vs {w['p95_ms']:.1f} ms)")
+
+    # -- phase B: 2x overload + chaos teacher-kill ----------------------
+
+    # slower chip (36 ms device batches): pool capacity ~2 * 222 rows/s
+    # = ~55 rps of 8-row requests; offered 111 rps is a 2x overload.
+    # SLO 500 ms ~= 3x the saturated pipeline latency: breached by
+    # queue collapse, not by the kill transient's tail
+    slo_ms = 500.0
+    adm = AdmissionConfig(batching="continuous", shed_ms=150.0)
+    servers = [TeacherServer(sleepy(0.004, 0.004), port=0,
+                             host="127.0.0.1", max_batch=8,
+                             admission=adm).start() for _ in range(2)]
+    live = [f"127.0.0.1:{s.port}" for s in servers]
+    by_ep = dict(zip(live, servers))
+    killed: dict = {}
+    kill_at = 1.5 * phase_s
+
+    def chaos_kill(i: int, t: float) -> None:
+        del i
+        if t >= kill_at and not killed:
+            ep = live.pop()
+            killed["ep"], killed["t"] = ep, t
+            # hard kill: stop() RSTs live connections; no drain, no
+            # deregistration — the loadgen must fail over on its own
+            threading.Thread(target=by_ep[ep].stop, daemon=True,
+                             name="serve-load-chaos").start()
+
+    stats = LoadStats()
+    try:
+        run_open_loop(lambda: list(live), duration_s=3.0 * phase_s,
+                      rps=111.0, rows=8,
+                      mix={"high": 0.1, "normal": 0.15, "low": 0.75},
+                      seed=12, stats=stats, on_arrival=chaos_kill)
+    finally:
+        for server in servers:
+            try:
+                server.stop()
+            except Exception:  # noqa: BLE001 — one already chaos-killed
+                pass
+    over = stats.summary(slo_ms=slo_ms)
+    cls = over["by_class"]
+    sheds = {c: v["shed"] for c, v in cls.items()}
+    low_share = sheds.get("low", 0) / max(sum(sheds.values()), 1)
+    first_shed = stats.first_event("shed")
+    gate(killed and over["shed"] >= 1 and first_shed is not None,
+         f"B: overload shed happened ({over['shed']} rejects)")
+    gate(cls["high"]["attainment"] is not None
+         and cls["high"]["attainment"] >= 0.9,
+         f"B: high class holds >=90% SLO attainment "
+         f"(got {cls['high']['attainment']})")
+    gate(cls["high"]["shed_pct"] <= 5.0,
+         f"B: high class (almost) never sheds "
+         f"(got {cls['high']['shed_pct']}%)")
+    gate(low_share >= 0.7 and cls["low"]["shed_pct"] >= 30.0,
+         f"B: shedding concentrates on low (low share "
+         f"{low_share:.2f}, low shed {cls['low']['shed_pct']}%)")
+    gate(first_shed is not None and stats.ok_after(first_shed) > 0,
+         "B: completions resume after the first shed")
+    gate(bool(killed) and stats.ok_after(killed.get("t", 0.0)) > 0,
+         "B: completions resume after the chaos kill (failover)")
+    gate(over["error"] <= 0.05 * max(over["offered"], 1),
+         f"B: errors bounded to the kill's in-flight "
+         f"({over['error']}/{over['offered']})")
+
+    ok = not failures
+    summary = {"ok": ok, "failures": failures,
+               "ab": {m: {load: {k: r[k] for k in
+                                 ("rps_offered", "rps_sustained",
+                                  "p50_ms", "p95_ms")}
+                          for load, r in runs.items()}
+                      for m, runs in ab.items()},
+               "overload": {**{k: over[k] for k in
+                               ("rps_offered", "rps_sustained",
+                                "offered", "ok", "shed", "error")},
+                            "slo_ms": slo_ms,
+                            "low_share_of_shed": round(low_share, 3),
+                            "by_class": cls}}
+    if not ok:
+        log.error("serve-load audit failed: %s", failures)
+    print("serve_load_summary=" + json.dumps(summary), flush=True)
+    return 0 if ok else 1
+
+
 def run_p2p_demo(args) -> int:
     """Peer-to-peer state migration end-to-end on one host: in-process
     store + JobServer (store-attached, so /resize publishes migration
@@ -829,7 +996,15 @@ def main(argv=None) -> int:
                              "scaler, self-audited grow + drained "
                              "shrink")
     parser.add_argument("--serve-phase-s", type=float, default=5.0,
-                        help="--serve-scaler: base load-phase seconds")
+                        help="--serve-scaler/--serve-load: base "
+                             "load-phase seconds")
+    # continuous-batching + admission-control dryrun (run_serve_load_demo)
+    parser.add_argument("--serve-load", action="store_true",
+                        help="run the serving load dryrun: open-loop "
+                             "generator vs window/continuous batching "
+                             "A/B, then 2x overload + chaos teacher "
+                             "kill with per-class shed/attainment "
+                             "audits")
     # peer-to-peer migration demo (see run_p2p_demo)
     parser.add_argument("--resize-p2p", action="store_true",
                         help="run the live-migration loop: store + "
@@ -851,9 +1026,12 @@ def main(argv=None) -> int:
                              "subdirs)")
     args = parser.parse_args(argv)
     if sum((args.scaler, args.resize_p2p, args.serve_scaler,
-            args.resize_reform)) > 1:
-        parser.error("--scaler, --serve-scaler, --resize-p2p and "
-                     "--resize-reform are separate demos")
+            args.serve_load, args.resize_reform)) > 1:
+        parser.error("--scaler, --serve-scaler, --serve-load, "
+                     "--resize-p2p and --resize-reform are separate "
+                     "demos")
+    if args.serve_load:
+        return run_serve_load_demo(args)
     if args.serve_scaler:
         return run_serve_scaler_demo(args)
     if args.resize_p2p:

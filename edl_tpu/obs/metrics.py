@@ -393,17 +393,27 @@ class Registry:
                     lines.append(f'{mname}{{iid="{iid}"}} '
                                  f'{_fmt_value(float(value))}')
                 elif isinstance(value, dict):
-                    # sub-histogram shape ({bucket: count}) -> labeled
+                    # sub-histogram shape ({bucket: count}) -> labeled.
+                    # Keys ending "_by_<label>" (e.g. queue_depth_by_class,
+                    # rejected_by_tenant) name their OWN label dimension
+                    # instead of the generic "bucket", so per-tenant /
+                    # per-class serving gauges come out as
+                    # edl_teacher_..._by_class{class="high"} — directly
+                    # aggregable in PromQL.
                     samples = [(k, v) for k, v in value.items()
                                if isinstance(v, (int, float))
                                and not isinstance(v, bool)]
                     if not samples:
                         continue
+                    label = "bucket"
+                    _, sep, suffix = key.rpartition("_by_")
+                    if sep and suffix.isidentifier():
+                        label = suffix
                     lines.append(f"# TYPE {mname} gauge")
                     for k, v in sorted(samples, key=lambda kv: str(kv[0])):
                         lines.append(
                             f'{mname}{{iid="{iid}",'
-                            f'bucket="{_escape_label(k)}"}} '
+                            f'{label}="{_escape_label(k)}"}} '
                             f'{_fmt_value(float(v))}')
         return "\n".join(lines) + "\n"
 

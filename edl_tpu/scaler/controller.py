@@ -398,6 +398,11 @@ class ScalerController:
             queue_depth=roll["queue_depth"],
             latency_ms_p50=roll["latency_ms_p50"],
             latency_ms_p95=roll["latency_ms_p95"],
+            shed_per_sec=roll.get("shed_per_sec", 0.0),
+            queue_depth_by_class=roll.get("queue_depth_by_class") or None,
+            latency_ms_p95_by_class=(roll.get("latency_ms_p95_by_class")
+                                     or None),
+            draining=roll.get("draining", 0),
             slo_p95_ms=cfg.slo_p95_ms,
             min_teachers=cfg.min_teachers,
             max_teachers=cfg.max_teachers,
@@ -550,6 +555,7 @@ class ScalerController:
             "util": round(view.util, 4),
             "queue_depth": view.queue_depth,
             "latency_ms_p95": view.latency_ms_p95,
+            "shed_per_sec": round(view.shed_per_sec, 2),
             "slo_p95_ms": view.slo_p95_ms, "fresh": view.fresh,
             "current": prop.current, "desired": prop.desired,
             "applied": applied, "action": action, "reason": reason})

@@ -14,10 +14,12 @@ Two halves, mirroring `policy.py`/`controller.py` for trainers:
 
 - `ServingPolicy` — the decision plane. Pure state machine over
   `ServingView` observations (no store, no wall clock: the caller
-  supplies ``now``), targeting a latency / queue-depth SLO with
-  **asymmetric hysteresis**: grow fast on *sustained* breach
-  (``breach_ticks`` consecutive observations over the p95 target or
-  queue high-water mark, multiplicative step bounded by
+  supplies ``now``), targeting a latency / queue-depth / shed-rate SLO
+  with **asymmetric hysteresis**: grow fast on *sustained* breach
+  (``breach_ticks`` consecutive observations over the p95 target,
+  queue high-water mark, or shed-rate ceiling — an admission-controlled
+  pool rejects its way back into the latency SLO, so sustained
+  shedding must count as overload — multiplicative step bounded by
   ``grow_max_factor``), shrink slowly on *sustained* idleness
   (``idle_ticks`` consecutive observations under the utilization
   low-water mark with an empty queue and p95 comfortably inside the
@@ -79,6 +81,15 @@ class ServingView:
     queue_depth: int = 0       # total intake backlog (requests)
     latency_ms_p50: float | None = None
     latency_ms_p95: float | None = None   # worst reporting teacher
+    # admission-control signals (r23 rollups; zero/empty from older
+    # registrars). Shedding is the policy's anti-blindness input: a
+    # pool under admission control holds its p95 in-SLO by REJECTING,
+    # so sustained shed_per_sec must count as a breach even while the
+    # latency numbers look healthy.
+    shed_per_sec: float = 0.0
+    queue_depth_by_class: dict | None = None   # {"high": 3, ...}
+    latency_ms_p95_by_class: dict | None = None
+    draining: int = 0          # teachers mid-drain (not real capacity)
     slo_p95_ms: float = 250.0  # the SLO contract travels with the view
     min_teachers: int = 1
     max_teachers: int = 8
@@ -99,6 +110,10 @@ class ServingConfig:
     # breach also when the backlog exceeds this many queued requests
     # PER teacher — queue growth leads the latency it will become
     queue_high: float = field(4.0, env="EDL_TPU_SERVE_QUEUE_HIGH")
+    # breach also when the pool sheds more than this many requests/sec:
+    # admission control keeps p95 in-SLO by rejecting, so a latency-only
+    # breach test goes blind exactly when the pool is most overloaded
+    shed_high: float = field(0.5, env="EDL_TPU_SERVE_SHED_HIGH")
     # shrink only under this mean busy fraction (low-water mark) ...
     util_low: float = field(0.3, env="EDL_TPU_SERVE_UTIL_LOW")
     # ... and only while p95 sits under this fraction of the SLO: the
@@ -150,7 +165,11 @@ class ServingPolicy:
         n = max(1, view.n_teachers)
         breach = ((view.latency_ms_p95 is not None
                    and view.latency_ms_p95 > slo)
-                  or view.queue_depth > cfg.queue_high * n)
+                  or view.queue_depth > cfg.queue_high * n
+                  # shed-blinded breach: under admission control the
+                  # pool REJECTS its way back into the latency SLO, so
+                  # sustained shedding is overload even at healthy p95
+                  or view.shed_per_sec > cfg.shed_high)
         # Backlog already paying down under existing capacity: arrivals
         # run below service rate (util off the ceiling) and the queue
         # shrank since the last look — growing now would buy teachers
@@ -191,6 +210,14 @@ class ServingPolicy:
             if cfg.queue_high > 0:
                 factor = max(factor,
                              view.queue_depth / (cfg.queue_high * cur))
+            if view.shed_per_sec > cfg.shed_high:
+                # offered / served: capacity for the load the pool is
+                # turning away, not just the load it admitted (shed is
+                # requests/s vs rows/s — an UNDER-estimate of pressure
+                # when requests batch rows, safe under the max())
+                factor = max(factor,
+                             (view.rows_per_sec + view.shed_per_sec)
+                             / max(view.rows_per_sec, 1.0))
             desired = min(view.max_teachers,
                           max(cur + 1,
                               math.ceil(cur * min(factor,
@@ -263,6 +290,11 @@ class LocalTeacher:
     def deregister(self) -> None:
         self.registrar.stop(deregister=True)
 
+    def drain(self) -> None:
+        """Stop admitting: pinned clients get reject-with-retry-after
+        (and re-resolve via discovery) instead of queueing forever."""
+        self.server.drain()
+
     def stop(self) -> None:
         self.server.stop()
 
@@ -299,6 +331,15 @@ class ProcessTeacher:
 
     def deregister(self) -> None:
         self.registrar.stop(deregister=True)
+
+    def drain(self) -> None:
+        """Flip the remote server into drain mode over the wire."""
+        from edl_tpu.distill.teacher_server import TeacherClient
+        client = TeacherClient(self.endpoint, timeout=2.0)
+        try:
+            client.drain()
+        finally:
+            client.close()
 
     def stop(self) -> None:
         from edl_tpu.collective.process import terminate_trainer
@@ -349,7 +390,11 @@ class TeacherPoolActuator:
 
       1. **deregister** from discovery — the balancer's keep-then-fill
          reassigns the teacher's readers on its next tick, so new work
-         stops arriving;
+         stops arriving — and, when the handle supports it (duck-typed
+         ``drain()``), flip the server itself into drain mode: further
+         submits get reject-with-retry-after, so a client pinned past
+         the deregistration re-resolves instead of re-arming the queue
+         forever (the pre-r23 hard-kill trigger);
       2. **wait for in-flight work** via the server's own stats: the
          intake queue empty AND zero in-flight groups for
          ``drain_quiet_polls`` consecutive polls;
@@ -455,6 +500,17 @@ class TeacherPoolActuator:
         except Exception as exc:  # noqa: BLE001 — registry outage must
             # not leave the teacher serving forever; keep draining
             log.warning("deregister %s failed: %s", entry["endpoint"], exc)
+        # duck-typed (getattr, not the Protocol): this module must stay
+        # importable without the distill plane, and pre-r23 handles
+        # without drain() keep the deregister-only behavior
+        drain_fn = getattr(handle, "drain", None)
+        if callable(drain_fn):
+            try:
+                drain_fn()
+            except Exception as exc:  # noqa: BLE001 — a dying server
+                # refusing the drain op still drains via deregistration
+                log.warning("drain op on %s failed: %s",
+                            entry["endpoint"], exc)
         deadline = t0 + self.drain_deadline_s
         quiet = 0
         while time.monotonic() < deadline:

@@ -141,6 +141,9 @@ ENV_VARS: dict[str, str] = {
     "EDL_TPU_SERVE_SLO_P95_MS": "serving latency SLO target (p95, ms)",
     "EDL_TPU_SERVE_QUEUE_HIGH": "queued requests per teacher counting as "
                                 "a breach",
+    "EDL_TPU_SERVE_SHED_HIGH": "pool-wide shed rate (rejects/sec) "
+                               "counting as a breach even at healthy "
+                               "p95",
     "EDL_TPU_SERVE_UTIL_LOW": "shrink only under this mean utilization",
     "EDL_TPU_SERVE_SHRINK_HEADROOM": "shrink only with p95 under this "
                                      "fraction of the SLO",
@@ -152,6 +155,19 @@ ENV_VARS: dict[str, str] = {
     "EDL_TPU_SERVE_MAX_TEACHERS": "pool ceiling",
     "EDL_TPU_SERVE_DRAIN_DEADLINE": "graceful-drain budget before "
                                     "hard-kill",
+    "EDL_TPU_SERVE_BATCHING": "teacher batch admission mode: continuous "
+                              "(iteration-level) or window (r6 coalesce)",
+    "EDL_TPU_SERVE_ADMIT_CAP": "bounded per-(tenant, class) teacher "
+                               "queue; past it submits reject with "
+                               "retry-after",
+    "EDL_TPU_SERVE_CLASS_WEIGHTS": "WFQ weights per priority class, "
+                                   "e.g. high=4,normal=2,low=1 (also "
+                                   "scales shed delay budgets)",
+    "EDL_TPU_SERVE_SHED_MS": "normal-class queue-delay budget (ms) for "
+                             "overload shedding; <=0 disables the "
+                             "delay-based shed rule",
+    "EDL_TPU_SERVE_RETRY_BUDGET": "reader-side bounded retry budget per "
+                                  "task on teacher shed responses",
     # -- analysis plane -----------------------------------------------------
     "EDL_TPU_LOCKGRAPH": "lock-order race detector during pytest (1 = on)",
     "EDL_TPU_LOCKGRAPH_OUT": "lockgraph JSON report path",

@@ -1,0 +1,607 @@
+"""Continuous batching + admission-controlled serving (r23).
+
+Four tiers, mirroring the subsystem's layering:
+
+  * `AdmissionQueue` logic under a fake clock — WFQ ordering, bounded
+    per-tenant queues, the per-class delay-budget shed rule, and the
+    drain flip (pure stdlib, no sockets);
+  * `Batcher` behavior — the stats() schema PIN (the r15 scaler, drain
+    poller, and registrar all consume these keys), continuous-mode
+    idle latency vs the window batcher, and coalescing under a busy
+    pipeline;
+  * the wire + pool tier — typed reject-with-retry-after over a real
+    socket, the reader's bounded shed-retry ladder, and a graceful
+    drain under continuous batching through `TeacherPoolActuator`
+    with ZERO hard kills;
+  * the control plane — registrar per-class windowed publish,
+    collector per-class rollup, the policy's shed-blinded-breach rule,
+    the balancer's class-weighted tie-break, and the obs renderer's
+    ``_by_class`` label promotion.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from edl_tpu.coord.collector import Collector
+from edl_tpu.coord.registry import ServiceRegistry
+from edl_tpu.coord.store import InMemStore
+from edl_tpu.distill.admission import (AdmissionConfig, AdmissionQueue,
+                                       AdmissionReject, RETRY_AFTER_MAX_MS,
+                                       RETRY_AFTER_MIN_MS,
+                                       parse_class_weights)
+from edl_tpu.distill.balance import ServiceBalance
+from edl_tpu.distill.teacher_server import (Batcher, TeacherClient,
+                                            TeacherRejected, TeacherServer)
+from edl_tpu.scaler.serving import (ServingConfig, ServingPolicy,
+                                    ServingView)
+
+ROOT = "edl_distill"
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def echo_predict(feeds):
+    rows = next(iter(feeds.values())).shape[0]
+    return {"logits": np.zeros((rows, 2), np.float32)}
+
+
+def feed(rows: int = 4, feat: int = 2) -> dict:
+    return {"x": np.zeros((rows, feat), np.float32)}
+
+
+# -- admission queue (logic tier, fake clock) --------------------------------
+
+
+class TestAdmissionQueue:
+    def make(self, clock=None, **kw):
+        return AdmissionQueue(AdmissionConfig(**kw),
+                              clock=clock or FakeClock())
+
+    def test_fifo_within_one_flow(self):
+        q = self.make()
+        for i in range(5):
+            q.submit(i, rows=1, tenant="a", priority="normal")
+        assert [q.get_nowait() for _ in range(5)] == list(range(5))
+        assert q.get_nowait() is None
+
+    def test_wfq_shares_track_class_weights(self):
+        """Drain a backlog where every class has equal demand: the pop
+        stream interleaves by weight (4:2:1), so between any two low
+        pops ~4 high pops land — not strict priority, not FIFO."""
+        q = self.make(class_weights="high=4,normal=2,low=1")
+        for i in range(12):
+            q.submit(("high", i), 1, "t", "high")
+            q.submit(("normal", i), 1, "t", "normal")
+            q.submit(("low", i), 1, "t", "low")
+        first_14 = [q.get_nowait()[0] for _ in range(14)]
+        counts = {c: first_14.count(c) for c in ("high", "normal", "low")}
+        assert counts["high"] == 8 and counts["normal"] == 4 \
+            and counts["low"] == 2, counts
+        # the backlog drains completely (work-conserving)
+        rest = [q.get_nowait() for _ in range(3 * 12 - 14)]
+        assert all(item is not None for item in rest)
+
+    def test_idle_flow_does_not_bank_credit(self):
+        """A flow idle while others drained must re-enter at the
+        CURRENT virtual time — not replay its stale credit and
+        monopolize the scheduler."""
+        q = self.make(class_weights="high=1,normal=1,low=1")
+        for i in range(50):
+            q.submit(("a", i), 1, "a", "normal")
+        for _ in range(50):
+            q.get_nowait()   # vclock advanced to 50
+        q.submit(("b", 0), 1, "b", "normal")   # fresh flow
+        q.submit(("a", 50), 1, "a", "normal")  # old flow, same vtime rule
+        got = {q.get_nowait()[0], q.get_nowait()[0]}
+        assert got == {"a", "b"}
+
+    def test_queue_cap_rejects_with_retry_hint(self):
+        q = self.make(queue_cap=2)
+        q.submit(1, 1, "a", "low")
+        q.submit(2, 1, "a", "low")
+        with pytest.raises(AdmissionReject) as exc:
+            q.submit(3, 1, "a", "low")
+        assert exc.value.reason == "queue-full"
+        assert RETRY_AFTER_MIN_MS <= exc.value.retry_after_ms \
+            <= RETRY_AFTER_MAX_MS
+        # the cap is per (class, tenant) flow: another tenant admits
+        q.submit(4, 1, "b", "low")
+
+    def test_overload_sheds_low_before_high(self):
+        """Warm the rate estimate, pile rows onto every class, and the
+        delay-budget rule (budget scales with class weight) sheds the
+        low class while high still admits."""
+        clock = FakeClock()
+        q = self.make(clock=clock, shed_ms=100.0,
+                      class_weights="high=4,normal=2,low=1")
+        q.note_served(64)
+        clock.advance(1.0)
+        q.note_served(64)   # ~64-128 rows/s measured rate
+        for cls in ("high", "normal", "low"):
+            q.submit((cls, "seed"), 8, "t", cls)
+        # low budget = 50 ms; its 8-row backlog against its 1/7 WFQ
+        # share of ~100 rows/s is ~550 ms of wait -> shed
+        with pytest.raises(AdmissionReject) as exc:
+            q.submit(("low", 1), 8, "t", "low")
+        assert exc.value.reason == "overload"
+        # high budget = 400 ms and a 4/7 share: same backlog admits
+        q.submit(("high", 1), 8, "t", "high")
+
+    def test_shed_rule_disarmed_cold_and_by_default(self):
+        clock = FakeClock()
+        q = self.make(clock=clock, shed_ms=50.0)
+        # no served rows yet: rate unknown -> never shed on a guess
+        for i in range(20):
+            q.submit(i, 8, "t", "low")
+        q2 = self.make()   # shed_ms=0 (default): rule off entirely
+        q2.note_served(1000)
+        for i in range(20):
+            q2.submit(i, 8, "t", "low")
+
+    def test_drain_flips_submits_to_typed_reject(self):
+        q = self.make()
+        q.submit("queued", 1, "t", "normal")
+        q.begin_drain()
+        with pytest.raises(AdmissionReject) as exc:
+            q.submit("late", 1, "t", "normal")
+        assert exc.value.reason == "draining"
+        # already-admitted work still drains normally
+        assert q.get_nowait() == "queued"
+        assert q.stats()["draining"] == 1
+
+    def test_unknown_priority_degrades_to_normal(self):
+        q = self.make()
+        q.submit("x", 1, "t", "platinum")
+        q.submit("y", 1, "t", None)
+        assert q.stats()["queue_depth_by_class"]["normal"] == 2
+
+    def test_stats_counters(self):
+        q = self.make(queue_cap=1)
+        q.submit(1, 2, "a", "high")
+        q.submit(2, 3, "b", "low")
+        for _ in range(2):
+            with pytest.raises(AdmissionReject):
+                q.submit(3, 1, "b", "low")
+        s = q.stats()
+        assert s["admitted_total"] == 2 and s["rejected_total"] == 2
+        assert s["rejected_by_class"]["low"] == 2
+        assert s["rejected_by_reason"] == {"queue-full": 2}
+        assert s["queue_depth_by_class"] == {"high": 1, "normal": 0,
+                                             "low": 1}
+        assert s["queue_depth_by_tenant"] == {"a": 1, "b": 1}
+
+    def test_get_timeout_and_close(self):
+        q = AdmissionQueue(AdmissionConfig())   # real clock: get() sleeps
+        t0 = time.monotonic()
+        assert q.get(timeout=0.05) is None
+        assert time.monotonic() - t0 < 2.0
+        q.close()
+        assert q.get(timeout=10.0) is None   # returns, no hang
+
+    def test_parse_class_weights_tolerates_junk(self):
+        w = parse_class_weights("high=3,bogus,low=x,platinum=9,normal=-1")
+        assert w == {"high": 3.0, "normal": 1.0, "low": 1.0}
+
+    def test_config_env_registry(self, monkeypatch):
+        monkeypatch.setenv("EDL_TPU_SERVE_BATCHING", "window")
+        monkeypatch.setenv("EDL_TPU_SERVE_ADMIT_CAP", "7")
+        monkeypatch.setenv("EDL_TPU_SERVE_SHED_MS", "33.5")
+        cfg = AdmissionConfig.from_env()
+        assert cfg.batching == "window" and cfg.queue_cap == 7
+        assert cfg.shed_ms == 33.5
+
+
+# -- batcher: schema pin + continuous vs window ------------------------------
+
+
+PINNED_STATS_KEYS = {
+    # the r6/r15 contract: scaler drain poller + registrar consume these
+    "served_rows", "served_requests", "busy_s", "uptime_s",
+    "queue_depth", "inflight_groups", "pending_hwm",
+    "coalesce_window_ms", "batch_rows_hist", "batch_rows_mean",
+    "latency_hist_ms", "latency_ms_p50", "latency_ms_p95",
+    # r23 additions (admission + per-class split)
+    "batching", "admitted_total", "rejected_total", "rejected_by_class",
+    "rejected_by_reason", "queue_depth_by_class", "queue_depth_by_tenant",
+    "draining", "latency_hist_ms_by_class", "latency_ms_p95_by_class",
+}
+
+
+class TestBatcher:
+    def test_stats_schema_pin(self):
+        """The stats() key set is a contract — additions are fine ONLY
+        via this pin; removals/renames break the scaler's drain poller,
+        the registrar differencing, and the obs gauges silently."""
+        b = Batcher(echo_predict, max_batch=8,
+                    admission=AdmissionConfig()).start()
+        try:
+            req = b.submit(feed(4), tenant="a", priority="high")
+            assert req.done.wait(timeout=5.0) and req.error is None
+            s = b.stats()
+        finally:
+            b.stop()
+        assert set(s) == PINNED_STATS_KEYS, (
+            f"missing={PINNED_STATS_KEYS - set(s)} "
+            f"extra={set(s) - PINNED_STATS_KEYS}")
+        assert s["served_rows"] == 4 and s["served_requests"] == 1
+        assert s["batching"] == "continuous"
+        assert s["admitted_total"] == 1 and s["rejected_total"] == 0
+        # JSON-shaped: one-level dicts with string keys, scalars else
+        for key in ("batch_rows_hist", "latency_hist_ms",
+                    "queue_depth_by_class", "rejected_by_class"):
+            assert all(isinstance(k, str) for k in s[key])
+
+    def test_continuous_idle_latency_beats_window(self):
+        """An idle continuous batcher dispatches a lone request
+        immediately; the window batcher holds it for max_wait. The
+        microscopic version of the bench's p95 acceptance gate."""
+        lat = {}
+        for mode in ("continuous", "window"):
+            b = Batcher(echo_predict, max_batch=8, max_wait=0.08,
+                        admission=AdmissionConfig(batching=mode)).start()
+            try:
+                t0 = time.monotonic()
+                req = b.submit(feed(2))
+                assert req.done.wait(timeout=5.0) and req.error is None
+                lat[mode] = time.monotonic() - t0
+            finally:
+                b.stop()
+        assert lat["continuous"] < 0.04, lat
+        assert lat["window"] >= 0.06, lat
+
+    def test_continuous_coalesces_against_busy_pipeline(self):
+        """While the pipeline computes, newly-arrived requests join the
+        FORMING group — the iteration-level admission that makes
+        saturated batches dense instead of degenerate singletons."""
+        release = threading.Event()
+
+        def gated(feeds):
+            release.wait(timeout=10.0)
+            return echo_predict(feeds)
+
+        b = Batcher(gated, max_batch=64, stage_depth=1,
+                    max_wait_cap=2.0,
+                    admission=AdmissionConfig(batching="continuous")).start()
+        try:
+            reqs = [b.submit(feed(4))]   # group 1 -> compute (gated)
+            time.sleep(0.15)
+            reqs.append(b.submit(feed(4)))   # group 2 fills the stage queue
+            time.sleep(0.15)
+            # pipeline full: these all merge into ONE forming group
+            reqs += [b.submit(feed(4)) for _ in range(5)]
+            time.sleep(0.15)
+            release.set()
+            for r in reqs:
+                assert r.done.wait(timeout=5.0) and r.error is None
+            hist = {int(k): v for k, v in
+                    b.stats()["batch_rows_hist"].items()}
+        finally:
+            release.set()
+            b.stop()
+        # 7 requests, but only 3 device batches: 4 + 4 + 20 merged
+        assert sum(hist.values()) == 3, hist
+        assert max(hist) == 20, hist
+
+    def test_unknown_batching_mode_raises(self):
+        with pytest.raises(ValueError):
+            Batcher(echo_predict,
+                    admission=AdmissionConfig(batching="magic"))
+
+    def test_drain_rejects_while_inflight_completes(self):
+        release = threading.Event()
+
+        def gated(feeds):
+            release.wait(timeout=10.0)
+            return echo_predict(feeds)
+
+        b = Batcher(gated, max_batch=8).start()
+        try:
+            req = b.submit(feed(2))
+            time.sleep(0.1)
+            b.begin_drain()
+            with pytest.raises(AdmissionReject) as exc:
+                b.submit(feed(2))
+            assert exc.value.reason == "draining"
+            release.set()
+            assert req.done.wait(timeout=5.0) and req.error is None
+            assert b.stats()["draining"] == 1
+        finally:
+            release.set()
+            b.stop()
+
+
+# -- wire tier: typed rejection + reader retry ladder ------------------------
+
+
+class TestWireRejection:
+    def test_client_raises_typed_reject_with_retry_after(self):
+        with TeacherServer(echo_predict, port=0,
+                           host="127.0.0.1") as server:
+            client = TeacherClient(f"127.0.0.1:{server.port}",
+                                   timeout=5.0)
+            try:
+                out = client.predict(feed(3))
+                assert out["logits"].shape == (3, 2)
+                assert client.drain() is True   # op: drain over the wire
+                with pytest.raises(TeacherRejected) as exc:
+                    client.predict(feed(3))
+                assert exc.value.reason == "draining"
+                assert exc.value.retry_after_ms >= RETRY_AFTER_MIN_MS
+                assert exc.value.retry_after_s == pytest.approx(
+                    exc.value.retry_after_ms / 1e3)
+                # the connection survived the rejection (typed response,
+                # not a reset): control ops still answer
+                assert client.stats()["draining"] == 1
+            finally:
+                client.close()
+
+    def test_priority_and_tenant_ride_the_wire(self):
+        with TeacherServer(echo_predict, port=0,
+                           host="127.0.0.1") as server:
+            client = TeacherClient(f"127.0.0.1:{server.port}",
+                                   timeout=5.0, tenant="acme",
+                                   priority="high")
+            try:
+                client.predict(feed(2))
+                s = client.stats()
+            finally:
+                client.close()
+            assert s["queue_depth_by_class"] == {"high": 0, "normal": 0,
+                                                 "low": 0}
+            hist = s["latency_hist_ms_by_class"]["high"]
+            assert sum(hist.values()) == 1   # counted under its class
+
+
+class _SheddingClient:
+    """Fake teacher that rejects the first ``sheds`` predicts."""
+
+    def __init__(self, endpoint, sheds, log_):
+        self.endpoint = endpoint
+        self.sheds = sheds
+        self.log = log_
+
+    def predict(self, feeds):
+        if self.sheds > 0:
+            self.sheds -= 1
+            self.log.append("shed")
+            raise TeacherRejected("busy", retry_after_ms=25.0)
+        self.log.append("ok")
+        return {"p": np.zeros((feeds["image"].shape[0], 1), np.float32)}
+
+    def close(self):
+        pass
+
+
+class TestReaderShedRetry:
+    def make_batches(self, n=3, rows=8):
+        return [{"image": np.ones((rows, 4), np.float32) * b}
+                for b in range(n)]
+
+    def test_shed_then_recover_within_budget(self):
+        from edl_tpu.distill.reader import DistillReader
+        calls: list[str] = []
+        batches = self.make_batches()
+        dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                           predicts=["p"], teachers=["t0"],
+                           teacher_batch_size=8, shed_retry_budget=8,
+                           client_factory=lambda ep: _SheddingClient(
+                               ep, sheds=2, log_=calls))
+        got = list(dr())
+        assert len(got) == len(batches)
+        assert calls.count("shed") == 2   # retried, never surfaced
+
+    def test_budget_exhaustion_fails_typed(self):
+        from edl_tpu.distill.reader import DistillReader, EdlDistillError
+        batches = self.make_batches(n=1)
+        dr = DistillReader(lambda: iter(batches), feeds=["image"],
+                           predicts=["p"], teachers=["t0"],
+                           teacher_batch_size=8, shed_retry_budget=1,
+                           client_factory=lambda ep: _SheddingClient(
+                               ep, sheds=99, log_=[]))
+        with pytest.raises(EdlDistillError, match="shedding"):
+            list(dr())
+
+
+# -- pool tier: graceful drain under continuous batching ---------------------
+
+
+class TestDrainUnderContinuous:
+    def test_actuator_drain_zero_hard_kills(self):
+        """Scale-down with continuous batching live: the victim's
+        in-flight request completes, NEW submits to it reject typed,
+        and the drain log shows graceful completion — no hard kill."""
+        from edl_tpu.distill.registrar import TeacherRegistrar
+        from edl_tpu.scaler.serving import LocalTeacher, TeacherPoolActuator
+        store = InMemStore()
+        gate = threading.Event()
+        teachers = []
+
+        def spawn(i):
+            def predict(feeds):
+                if i == 1:
+                    gate.wait(timeout=10.0)
+                return echo_predict(feeds)
+            server = TeacherServer(
+                predict, port=0, host="127.0.0.1", max_batch=16,
+                admission=AdmissionConfig(batching="continuous")).start()
+            registrar = TeacherRegistrar(store, "svc",
+                                         f"127.0.0.1:{server.port}",
+                                         ttl=5.0, stats_interval=0.1)
+            registrar.start()
+            t = LocalTeacher(server, registrar)
+            teachers.append(t)
+            return t
+
+        actuator = TeacherPoolActuator(spawn, max_teachers=4,
+                                       drain_deadline_s=10.0,
+                                       drain_poll_s=0.02, service="svc")
+        try:
+            actuator.resize(2)
+            victim = teachers[1]   # LIFO retirement
+            client = TeacherClient(victim.endpoint, timeout=10.0)
+            pending = client.predict_async(feed(4))   # parked on gate
+            time.sleep(0.15)
+            actuator.resize(1)
+            time.sleep(0.2)
+            # drain-mode admission: the victim now rejects new work —
+            # probed on a SECOND connection (responses are FIFO per
+            # connection; the first one's head is parked on the gate)
+            probe = TeacherClient(victim.endpoint, timeout=10.0)
+            with pytest.raises(TeacherRejected):
+                probe.predict(feed(4))
+            probe.close()
+            gate.set()
+            out = pending.result()   # in-flight completed, no reset
+            assert out["logits"].shape == (4, 2)
+            assert actuator.wait_drains(timeout=10.0)
+            (entry,) = actuator.drain_log
+            assert entry["drained"] and not entry["hard_killed"], entry
+            client.close()
+        finally:
+            gate.set()
+            actuator.close()
+
+
+# -- control plane: registrar -> rollup -> policy / balance / obs ------------
+
+
+class TestRegistrarPerClass:
+    def test_windowed_per_class_publish(self):
+        from edl_tpu.distill.registrar import TeacherRegistrar
+        registrar = TeacherRegistrar(InMemStore(), "svc", "h:1")
+        prev = {"served_rows": 100, "busy_s": 1.0,
+                "latency_hist_ms": {"10.0": 100},
+                "latency_hist_ms_by_class": {"high": {"10.0": 100}},
+                "rejected_total": 10,
+                "rejected_by_class": {"low": 10}}
+        cur = {"served_rows": 200, "busy_s": 2.0, "queue_depth": 3,
+               "latency_hist_ms": {"10.0": 100, "500.0": 50},
+               "latency_hist_ms_by_class": {
+                   "high": {"10.0": 100, "500.0": 20},
+                   "low": {"500.0": 30}},
+               "rejected_total": 60,
+               "rejected_by_class": {"low": 45, "normal": 5},
+               "queue_depth_by_class": {"high": 1, "low": 2},
+               "draining": 1}
+        info = json.loads(registrar._utilization_info(cur, prev, dt=5.0))
+        # per-class p95 is the WINDOW (high's fast past subtracted out)
+        assert info["latency_ms_p95_by_class"] == {"high": 500.0,
+                                                   "low": 500.0}
+        assert info["shed_per_sec"] == 10.0      # 50 rejects / 5 s
+        assert info["shed_by_class"] == {"low": 35, "normal": 5}
+        assert info["queue_depth_by_class"] == {"high": 1, "low": 2}
+        assert info["draining"] == 1
+
+
+class TestRollupPerClass:
+    def test_rollup_sums_shed_and_merges_per_class(self):
+        store = InMemStore()
+        registry = ServiceRegistry(store, root=ROOT)
+        registry.register_permanent("svc", "h:1", info=json.dumps(
+            {"rows_per_sec": 100.0, "util": 0.5, "queue_depth": 2,
+             "latency_ms_p95": 40.0, "shed_per_sec": 1.5,
+             "queue_depth_by_class": {"high": 1, "low": 1},
+             "latency_ms_p95_by_class": {"high": 30.0, "low": 40.0},
+             "draining": 0}))
+        registry.register_permanent("svc", "h:2", info=json.dumps(
+            {"rows_per_sec": 80.0, "util": 0.7, "queue_depth": 4,
+             "latency_ms_p95": 90.0, "shed_per_sec": 2.0,
+             "queue_depth_by_class": {"high": 2, "normal": 2},
+             "latency_ms_p95_by_class": {"high": 80.0},
+             "draining": 1}))
+        roll = Collector(store, services=("svc",),
+                         registry_root=ROOT).service_rollup("svc")
+        assert roll["shed_per_sec"] == 3.5            # pool sum
+        assert roll["queue_depth_by_class"] == {"high": 3, "low": 1,
+                                                "normal": 2}
+        # worst reporting teacher per class (same rule as the flat p95)
+        assert roll["latency_ms_p95_by_class"] == {"high": 80.0,
+                                                   "low": 40.0}
+        assert roll["draining"] == 1
+
+
+class TestPolicyShedBreach:
+    def make_view(self, **kw):
+        kw.setdefault("service", "svc")
+        kw.setdefault("n_teachers", 2)
+        kw.setdefault("rows_per_sec", 100.0)
+        kw.setdefault("latency_ms_p95", 50.0)   # healthy latency
+        kw.setdefault("slo_p95_ms", 250.0)
+        return ServingView(**kw)
+
+    def test_healthy_p95_but_shedding_is_a_breach(self):
+        """The anti-blindness rule: an admission-controlled pool holds
+        p95 in-SLO by REJECTING — sustained shed is overload."""
+        policy = ServingPolicy(ServingConfig(breach_ticks=2,
+                                             cooldown_s=0.0))
+        view = self.make_view(shed_per_sec=5.0)
+        (p1,) = policy.decide([view], now=1.0)
+        assert p1.reason == "in-band"   # one breach tick: no action yet
+        (p2,) = policy.decide([view], now=2.0)
+        assert p2.reason == "slo-breach-grow"
+        # grow factor covers OFFERED load: (100 + 5) / 100 ~ 1.05 ->
+        # still at least +1 teacher
+        assert p2.desired >= 3
+
+    def test_shed_below_threshold_stays_in_band(self):
+        policy = ServingPolicy(ServingConfig(breach_ticks=1,
+                                             cooldown_s=0.0))
+        view = self.make_view(shed_per_sec=0.2, util=0.6)
+        (p,) = policy.decide([view], now=1.0)
+        assert p.reason == "in-band"
+
+    def test_shed_grow_scales_with_offered_over_served(self):
+        policy = ServingPolicy(ServingConfig(breach_ticks=1,
+                                             cooldown_s=0.0))
+        # shedding as much as it serves -> offered/served = 2x
+        view = self.make_view(shed_per_sec=100.0)
+        (p,) = policy.decide([view], now=1.0)
+        assert p.desired == 4   # 2 teachers * 2.0 factor
+
+
+class TestBalanceClassTieBreak:
+    def test_queued_high_outweighs_queued_low(self):
+        """Equal flat depth, different class mix: the teacher with the
+        queued HIGH work is the busier tie-break candidate."""
+        bal = ServiceBalance("svc")
+        bal.set_utilization({"a:1": 0.5, "b:1": 0.5},
+                            queue_depth={"a:1": 4, "b:1": 4},
+                            queue_depth_by_class={
+                                "a:1": {"high": 4},
+                                "b:1": {"low": 4}})
+        assert bal._busy("a:1") > bal._busy("b:1")
+        # class-split replaces the flat term; unknown class falls back
+        bal2 = ServiceBalance("svc")
+        bal2.set_utilization({"c:1": 0.0},
+                             queue_depth_by_class={"c:1": {"gold": 2}})
+        assert bal2._busy("c:1") == pytest.approx(
+            0.0 + ServiceBalance.QUEUE_WEIGHT * 2)
+
+
+class TestObsByClassLabels:
+    def test_render_promotes_by_class_suffix_to_label(self):
+        from edl_tpu.obs.metrics import Registry
+        reg = Registry(namespace="edl")
+        reg.register_stats("teacher", lambda: {
+            "queue_depth": 3,
+            "queue_depth_by_class": {"high": 1, "low": 2},
+            "rejected_by_tenant": {"acme": 7}})
+        text = reg.render()
+        assert 'edl_teacher_queue_depth{iid="0"} 3' in text
+        assert ('edl_teacher_queue_depth_by_class{iid="0",class="high"} 1'
+                in text)
+        assert ('edl_teacher_rejected_by_tenant{iid="0",tenant="acme"} 7'
+                in text)

@@ -1,0 +1,154 @@
+"""Open-loop load sweep for the teacher serving tier (r23).
+
+Probes REAL `TeacherServer`s (sleepy predict_fn standing in for chip
+time, so the numbers are scheduling numbers, not model numbers) with
+the open-loop generator (`edl_tpu.distill.loadgen`) across a batching
+mode x offered-rate grid, and prints ONE markdown table per section:
+
+  * latency sweep — window vs continuous batching at each offered
+    rate: sustained rps, p50/p95, shed%. The continuous rows should
+    dominate the window rows on latency at every rate below
+    saturation at equal sustained throughput (the ``--serve-load``
+    CI dryrun pins the 1.5x floor; this tool shows the whole curve);
+  * overload section (``--overload``) — 2x the measured capacity on a
+    high/normal/low mix with the shed rule armed, reporting per-class
+    shed% / p95 / SLO attainment (graceful degradation is per class,
+    never global).
+
+  python tools/serve_load_bench.py --duration 5
+  python tools/serve_load_bench.py --overload --shed-ms 150
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/serve_load_bench.py` puts
+    sys.path.insert(0, REPO)  # tools/ on sys.path, not the repo root
+
+
+def sleepy(per_row_s: float, base_s: float):
+    import numpy as np
+
+    def predict(feeds):
+        rows = next(iter(feeds.values())).shape[0]
+        time.sleep(base_s + per_row_s * rows)
+        return {"logits": np.zeros((rows, 4), np.float32)}
+    return predict
+
+
+def fmt(x, nd=1) -> str:
+    return "-" if x is None else f"{x:.{nd}f}"
+
+
+def latency_sweep(args) -> None:
+    from edl_tpu.distill.admission import AdmissionConfig
+    from edl_tpu.distill.loadgen import run_open_loop
+    from edl_tpu.distill.teacher_server import TeacherServer
+
+    rates = [float(r) for r in args.rps.split(",") if r]
+    print(f"\n## window vs continuous ({args.rows}-row requests, "
+          f"{args.duration:.0f}s per cell)\n")
+    print("| mode | offered rps | sustained rps | p50 ms | p95 ms "
+          "| shed % |")
+    print("|---|---|---|---|---|---|")
+    for mode in ("window", "continuous"):
+        server = TeacherServer(
+            sleepy(args.per_row_ms / 1e3, args.base_ms / 1e3),
+            port=0, host="127.0.0.1", max_batch=args.max_batch,
+            max_wait=args.window_ms / 1e3,
+            admission=AdmissionConfig(batching=mode,
+                                      shed_ms=args.shed_ms)).start()
+        try:
+            for rps in rates:
+                s = run_open_loop(
+                    [f"127.0.0.1:{server.port}"],
+                    duration_s=args.duration, rps=rps, rows=args.rows,
+                    seed=args.seed).summary()
+                print(f"| {mode} | {fmt(s['rps_offered'])} "
+                      f"| {fmt(s['rps_sustained'])} "
+                      f"| {fmt(s['p50_ms'])} | {fmt(s['p95_ms'])} "
+                      f"| {fmt(100.0 * s['shed'] / max(s['offered'], 1))}"
+                      f" |")
+        finally:
+            server.stop()
+
+
+def overload(args) -> None:
+    from edl_tpu.distill.admission import AdmissionConfig
+    from edl_tpu.distill.loadgen import run_open_loop
+    from edl_tpu.distill.teacher_server import TeacherServer
+
+    adm = AdmissionConfig(batching="continuous",
+                          shed_ms=args.shed_ms or 150.0)
+    servers = [TeacherServer(sleepy(0.004, 0.004), port=0,
+                             host="127.0.0.1", max_batch=8,
+                             admission=adm).start()
+               for _ in range(args.teachers)]
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+    # one teacher ~222 rows/s on the 36 ms fake chip; offer 2x the pool
+    rps = args.teachers * 222.0 / args.rows * 2.0
+    try:
+        s = run_open_loop(
+            eps, duration_s=3 * args.duration, rps=rps, rows=args.rows,
+            mix={"high": 0.1, "normal": 0.15, "low": 0.75},
+            seed=args.seed).summary(slo_ms=args.slo_ms)
+    finally:
+        for server in servers:
+            server.stop()
+    print(f"\n## 2x overload, {args.teachers} teachers, shed_ms="
+          f"{adm.shed_ms:.0f}, SLO {args.slo_ms:.0f} ms "
+          f"(offered {s['rps_offered']} rps, sustained "
+          f"{s['rps_sustained']} rps)\n")
+    print("| class | offered | ok | shed % | p50 ms | p95 ms "
+          "| attainment |")
+    print("|---|---|---|---|---|---|---|")
+    for cls in ("high", "normal", "low"):
+        c = s["by_class"].get(cls)
+        if c is None:
+            continue
+        print(f"| {cls} | {c['offered']} | {c['ok']} "
+              f"| {fmt(c['shed_pct'])} | {fmt(c['p50_ms'])} "
+              f"| {fmt(c['p95_ms'])} | {fmt(c['attainment'], 3)} |")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tools/serve_load_bench.py")
+    parser.add_argument("--duration", type=float, default=5.0,
+                        help="seconds per sweep cell")
+    parser.add_argument("--rps", default="25,50,100,200",
+                        help="comma-joined offered request rates")
+    parser.add_argument("--rows", type=int, default=4,
+                        help="rows per predict request")
+    parser.add_argument("--per-row-ms", type=float, default=0.3)
+    parser.add_argument("--base-ms", type=float, default=1.0)
+    parser.add_argument("--window-ms", type=float, default=20.0,
+                        help="window-mode coalesce wait")
+    parser.add_argument("--max-batch", type=int, default=64)
+    parser.add_argument("--shed-ms", type=float, default=0.0,
+                        help="normal-class delay budget (0 = no "
+                             "overload shedding in the sweep)")
+    parser.add_argument("--slo-ms", type=float, default=500.0)
+    parser.add_argument("--teachers", type=int, default=2,
+                        help="--overload: pool size")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--overload", action="store_true",
+                        help="also run the 2x-overload per-class "
+                             "degradation section (8-row requests)")
+    parser.add_argument("--no-sweep", action="store_true",
+                        help="skip the latency sweep section")
+    args = parser.parse_args(argv)
+    if not args.no_sweep:
+        latency_sweep(args)
+    if args.overload:
+        args.rows = 8
+        overload(args)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
