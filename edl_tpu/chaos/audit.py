@@ -132,6 +132,33 @@ class InvariantAuditor:
                        f"nor visible after resync (e.g. {lost[:3]})")
         rep.stats["marks_acked"] = len(acked)
         rep.stats["marks_delivered"] = len(seen)
+        # Relay-attached consumer (coord/relay.py): when the probe ran
+        # a second watch THROUGH the watch-relay tier, the exact same
+        # exactly-once bar applies to its ledger — a relay SIGKILL must
+        # look like a server restart (resume by revision), so zero
+        # duplicates, zero branch anomalies, and every acked value
+        # delivered or visible after resync, same as the direct path.
+        relay_seen_raw = self.probe.get("relay_seen")
+        if relay_seen_raw is not None:
+            relay_seen = {int(k): v for k, v in relay_seen_raw.items()}
+            rdup = int(self.probe.get("relay_duplicates", 0))
+            if rdup:
+                rep.breach(f"I1: {rdup} duplicate deliveries through "
+                           "the relay tier")
+            rbranch = int(self.probe.get("relay_branch_anomalies", 0))
+            if rbranch:
+                rep.breach(f"I1: {rbranch} branch anomalies through the "
+                           "relay — relayed watchers observed "
+                           "uncommitted entries; the relay leaked past "
+                           "the commit gate")
+            relay_delivered = set(relay_seen.values())
+            rlost = [v for v in acked
+                     if v not in relay_delivered and v not in final]
+            if rlost:
+                rep.breach(f"I1: {len(rlost)} acked marks lost through "
+                           f"the relay tier (e.g. {rlost[:3]})")
+            rep.stats["relay_marks_delivered"] = len(relay_seen)
+            rep.stats["relay_branch_anomalies"] = rbranch
         # Worker-side sequence observability: within one watch session
         # revisions normally increase strictly. Across a leader
         # failover they may NOT — the same uncommitted-suffix anomaly

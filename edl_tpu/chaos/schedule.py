@@ -41,6 +41,10 @@ FAULT_CLASSES = (
     "reform",           # resize + a mid-phase fault (kill a donor,
                         # SIGSTOP a survivor, partition the store) —
                         # the reform state machine's I6 drill
+    "relay",            # SIGKILL the watch-relay tier mid-stream: the
+                        # downstreams must resume by revision off the
+                        # respawned relay (zero lost, zero duplicated —
+                        # I1 runs through the relay-attached consumer)
 )
 
 # Per-class weights for the tail of the schedule (the head cycles every
@@ -48,7 +52,7 @@ FAULT_CLASSES = (
 _WEIGHTS = {
     "wire": 4, "process-kill": 3, "process-pause": 2,
     "store-partition": 2, "leader-kill": 1, "ckpt-corrupt": 3,
-    "resize": 2, "pool-resize": 2, "reform": 2,
+    "resize": 2, "pool-resize": 2, "reform": 2, "relay": 1,
 }
 
 
@@ -85,6 +89,13 @@ def _draw_event(rng: random.Random, fault: str, t: float, *,
                           duration=round(rng.uniform(1.0, 2.5), 3))
     if fault == "leader-kill":
         return FaultEvent(t, "leader-kill", "replica:leader")
+    if fault == "relay":
+        # duration = dead window before the respawn: long enough that
+        # downstream watches hit the reconnect/backoff path, short
+        # enough that the store's event history still holds their
+        # resume revisions (so recovery is resume, not resync)
+        return FaultEvent(t, "relay", "relay",
+                          duration=round(rng.uniform(1.0, 2.0), 3))
     if fault == "ckpt-corrupt":
         return FaultEvent(t, "ckpt-corrupt", f"pod:{rng.randrange(pods)}",
                           params={"mode": rng.choice(["bitflip",

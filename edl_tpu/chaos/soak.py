@@ -213,14 +213,24 @@ class Supervisor:
 
 class MarkProbe:
     """The I1 ledger: a writer streams acked marks, a dedicated watch
-    consumes the event stream; both sides' records feed the audit."""
+    consumes the event stream; both sides' records feed the audit.
 
-    def __init__(self, endpoints: str, *, rate_s: float = 0.06):
+    With ``relay_endpoint`` a SECOND consumer watches the same prefix
+    THROUGH the watch-relay tier: its deliveries get their own ledger
+    (``relay_seen``/``relay_duplicates``) so I1's exactly-once check
+    runs over the relay path too — including across a relay SIGKILL,
+    where the downstream must resume by revision off the respawn."""
+
+    def __init__(self, endpoints: str, *, rate_s: float = 0.06,
+                 relay_endpoint: str | None = None):
         self.acked: dict[str, int] = {}   # writer-thread only until stop
         self.refused = 0                  # writer-thread only until stop
         self.seen: dict[int, str] = {}    # consumer-thread only until stop
         self.duplicates = 0               # consumer-thread only until stop
         self.branch_anomalies = 0         # consumer-thread only until stop
+        self.relay_seen: dict[int, str] = {}   # relay-consumer only
+        self.relay_duplicates = 0              # relay-consumer only
+        self.relay_branch_anomalies = 0        # relay-consumer only
         self.final_values: list[str] = []
         self._rate_s = rate_s
         self._client = StoreClient(endpoints, timeout=2.0,
@@ -236,10 +246,25 @@ class MarkProbe:
         self._consumer = threading.Thread(target=self._consume_loop,
                                           daemon=True,
                                           name="chaos-marks-r")
+        self._relay_client: StoreClient | None = None
+        self._relay_watch = None
+        self._relay_consumer: threading.Thread | None = None
+        if relay_endpoint:
+            # via_relay=False: we ARE dialing the relay — no re-route
+            self._relay_client = StoreClient(relay_endpoint, timeout=2.0,
+                                             connect_retries=6,
+                                             retry_interval=0.1)
+            self._relay_watch = self._relay_client.watch(
+                marks_prefix(JOB), start_revision=0, via_relay=False)
+            self._relay_consumer = threading.Thread(
+                target=self._relay_consume_loop, daemon=True,
+                name="chaos-marks-relay-r")
 
     def start(self) -> "MarkProbe":
         self._writer.start()
         self._consumer.start()
+        if self._relay_consumer is not None:
+            self._relay_consumer.start()
         return self
 
     def _write_loop(self) -> None:
@@ -257,27 +282,48 @@ class MarkProbe:
                 self.refused += 1
             i += 1
 
+    def _ingest(self, batch, seen: dict[int, str]) -> tuple[int, int]:
+        """Fold one watch batch into a (revision -> value) ledger;
+        returns (duplicates, branch_anomalies) deltas."""
+        dups = branches = 0
+        for ev in batch.events:
+            if ev.type != "PUT":
+                continue
+            prev = seen.get(ev.revision)
+            if prev == ev.value:
+                # the same (revision, value) twice = a true replay
+                # duplicate (the resume contract broken)
+                dups += 1
+            elif prev is not None:
+                # same revision, DIFFERENT value: the watcher
+                # observed a deposed leader's uncommitted suffix
+                # whose revision numbers the new reign reused —
+                # the documented weaker-than-Raft anomaly. Keep
+                # the later (committed-branch) value.
+                branches += 1
+            seen[ev.revision] = ev.value
+        return dups, branches
+
     def _consume_loop(self) -> None:
         while not self._stop.is_set():
             batch = self._watch.get(timeout=0.2)
             if batch is None:
                 continue
-            for ev in batch.events:
-                if ev.type != "PUT":
-                    continue
-                prev = self.seen.get(ev.revision)
-                if prev == ev.value:
-                    # the same (revision, value) twice = a true replay
-                    # duplicate (the resume contract broken)
-                    self.duplicates += 1
-                elif prev is not None:
-                    # same revision, DIFFERENT value: the watcher
-                    # observed a deposed leader's uncommitted suffix
-                    # whose revision numbers the new reign reused —
-                    # the documented weaker-than-Raft anomaly. Keep
-                    # the later (committed-branch) value.
-                    self.branch_anomalies += 1
-                self.seen[ev.revision] = ev.value
+            dups, branches = self._ingest(batch, self.seen)
+            self.duplicates += dups
+            self.branch_anomalies += branches
+
+    def _relay_consume_loop(self) -> None:
+        # identical ledger discipline, but every event arrived through
+        # the relay tier — so a relay kill/respawn that lost or
+        # replayed anything shows up here, not just on the direct path
+        while not self._stop.is_set():
+            batch = self._relay_watch.get(timeout=0.2)
+            if batch is None:
+                continue
+            dups, branches = self._ingest(batch, self.relay_seen)
+            self.relay_duplicates += dups
+            self.relay_branch_anomalies += branches
 
     def probe_put(self) -> bool:
         try:
@@ -289,24 +335,40 @@ class MarkProbe:
     def close(self) -> dict:
         return self.stop_and_collect()
 
+    def _doc(self) -> dict:
+        doc = {"acked": self.acked, "seen": self.seen,
+               "duplicates": self.duplicates,
+               "branch_anomalies": self.branch_anomalies,
+               "refused": self.refused,
+               "final_values": self.final_values}
+        if self._relay_watch is not None:
+            doc["relay_seen"] = self.relay_seen
+            doc["relay_duplicates"] = self.relay_duplicates
+            doc["relay_branch_anomalies"] = self.relay_branch_anomalies
+        return doc
+
     def stop_and_collect(self) -> dict:
         if self._stop.is_set():  # idempotent: the crash path re-enters
-            return {"acked": self.acked, "seen": self.seen,
-                    "duplicates": self.duplicates,
-                    "branch_anomalies": self.branch_anomalies,
-                    "refused": self.refused,
-                    "final_values": self.final_values}
+            return self._doc()
         self._stop.set()
         self._writer.join(timeout=10.0)
-        # drain whatever the watch still holds
+        # drain whatever the watches still hold (the relay consumer may
+        # additionally be mid-resume off a respawned relay)
         deadline = time.monotonic() + 8.0
         max_acked = max(self.acked.values(), default=0)
         while time.monotonic() < deadline:
-            if self.seen and max(self.seen) >= max_acked:
+            direct_ok = self.seen and max(self.seen) >= max_acked
+            relay_ok = self._relay_watch is None or (
+                self.relay_seen and max(self.relay_seen) >= max_acked)
+            if direct_ok and relay_ok:
                 break
             time.sleep(0.1)
         self._consumer.join(timeout=5.0)
         self._watch.cancel()
+        if self._relay_consumer is not None:
+            self._relay_consumer.join(timeout=5.0)
+        if self._relay_watch is not None:
+            self._relay_watch.cancel()
         try:
             records, _ = self._client.get_prefix(marks_prefix(JOB))
             self.final_values = [r.value for r in records]
@@ -314,11 +376,9 @@ class MarkProbe:
             pass
         self._client.close()
         self._watch_client.close()
-        return {"acked": self.acked, "seen": self.seen,
-                "duplicates": self.duplicates,
-                "branch_anomalies": self.branch_anomalies,
-                "refused": self.refused,
-                "final_values": self.final_values}
+        if self._relay_client is not None:
+            self._relay_client.close()
+        return self._doc()
 
 
 class SoakWorld:
@@ -393,7 +453,42 @@ class SoakWorld:
         self.pool_journal.append({"to": 1, "ts": round(time.time(), 3)})
         self.actuator.resize(1)
 
-        self.probe = MarkProbe(self.endpoints_spec).start()
+        # The watch-relay tier as a REAL subprocess (coord/relay.py):
+        # the probe's relay consumer rides through it, and the "relay"
+        # fault class SIGKILLs it mid-stream — recovery must look like
+        # a server restart (reconnect + resume by revision).
+        self._relay_env = worker_env
+        self.relay_port = free_port()
+        self.relay_endpoint = f"127.0.0.1:{self.relay_port}"
+        self.relay_proc = None
+        self._spawn_relay(wait=True)
+
+        self.probe = MarkProbe(self.endpoints_spec,
+                               relay_endpoint=self.relay_endpoint).start()
+
+    def _spawn_relay(self, wait: bool = False) -> None:
+        if self.relay_proc is not None and self.relay_proc.alive():
+            return
+        cmd = [sys.executable, "-m", "edl_tpu.coord.relay", "serve",
+               "--host", "127.0.0.1", "--port", str(self.relay_port),
+               "--upstream", self.endpoints_spec]
+        self.relay_proc = start_trainer(
+            cmd, self._relay_env, os.path.join(self.report_dir, "log"),
+            rank=90)  # rank only names the log file (workerlog.90)
+        if not wait:
+            return
+        probe = StoreClient(self.relay_endpoint, timeout=1.0,
+                            connect_retries=1, retry_interval=0.05)
+        try:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                if probe.ping():
+                    return
+                time.sleep(0.1)
+            raise EdlStoreError(
+                f"relay at {self.relay_endpoint} not up within 15s")
+        finally:
+            probe.close()
 
     def _wait_leader(self, timeout: float) -> None:
         deadline = time.monotonic() + timeout
@@ -485,6 +580,20 @@ class SoakWorld:
                 rec["replica"] = self.endpoints[idx]
                 self._pending.append(
                     (time.monotonic() + 1.5, "replica-respawn", idx))
+            elif fault == "relay":
+                proc = self.relay_proc
+                if proc is None or not proc.alive():
+                    rec["resolution"] = {"skipped": "relay already down"}
+                    return
+                # snapshot of the relay consumer's cursor: resolution
+                # demands it ADVANCES past this after the respawn
+                rec["relay_rev_at_inject"] = max(self.probe.relay_seen,
+                                                 default=0)
+                fl.ProcessChaos.sigkill(proc)
+                rec["pid"] = proc.pid
+                self._pending.append(
+                    (time.monotonic() + max(event.duration, 1.0),
+                     "relay-respawn", None))
             elif fault == "ckpt-corrupt":
                 slot = int(event.target.split(":", 1)[1])
                 mode = ("bitflip" if self.args.weaken_checksums
@@ -566,6 +675,8 @@ class SoakWorld:
                     fl.StorePartitioner.heal(payload)
                 elif kind == "replica-respawn":
                     self._respawn_replica(payload)
+                elif kind == "relay-respawn":
+                    self._spawn_relay()
             except Exception:  # noqa: BLE001 — retried at settle
                 log.exception("pending action %s failed", kind)
 
@@ -613,6 +724,7 @@ class SoakWorld:
         for i, srv in enumerate(self.replicas):
             if srv is None:
                 self._respawn_replica(i)
+        self._spawn_relay()
         self.supervisor.resume_all()
         self._wait_leader(20.0)
         deadline = time.monotonic() + self.args.settle_s
@@ -696,6 +808,22 @@ class SoakWorld:
                     {"recovered": False,
                      "detail": f"live={live} desired={desired} "
                                f"probe={probe_ok}"})
+            elif fault == "relay":
+                alive = (self.relay_proc is not None
+                         and self.relay_proc.alive())
+                # recovered = respawned AND the relay consumer's cursor
+                # moved past where it stood at the kill — the stream
+                # RESUMED, it didn't just reconnect to silence. (Loss/
+                # duplication accounting is I1's job over relay_seen.)
+                cursor = max(self.probe.relay_seen, default=0)
+                moved = cursor > inj.get("relay_rev_at_inject", 0)
+                inj["resolution"] = (
+                    {"recovered": True, "relay_rev": cursor}
+                    if alive and moved else
+                    {"recovered": False,
+                     "detail": f"alive={alive} cursor={cursor} "
+                               f"at_inject="
+                               f"{inj.get('relay_rev_at_inject')}"})
             elif fault == "pool-resize":
                 want = self.pool_journal[-1]["to"]
                 got = self.actuator.pool_size()
@@ -770,6 +898,8 @@ class SoakWorld:
             self.actuator.close()
         if hasattr(self, "job_server"):
             self.job_server.stop()
+        if getattr(self, "relay_proc", None) is not None:
+            terminate_trainer(self.relay_proc, grace=2.0)
         for srv in getattr(self, "replicas", []):
             if srv is not None:
                 srv.stop()

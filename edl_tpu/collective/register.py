@@ -19,16 +19,29 @@ the graceful paths.
 
 from __future__ import annotations
 
+import socket
 import threading
 import time
 
 from edl_tpu.collective.cluster import Pod
-from edl_tpu.coord.client import LeaseKeeper
+from edl_tpu.coord.client import HostLeaseCoalescer, LeaseKeeper, \
+    host_coalescer
 from edl_tpu.coord.store import Store
+from edl_tpu.utils import config
 from edl_tpu.utils.exceptions import EdlRegisterError
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.collective.register")
+
+
+def default_coalescer(store: Store, ttl: float) -> HostLeaseCoalescer | None:
+    """The host-shared lease coalescer when EDL_TPU_LEASE_COALESCE=1
+    (default off: per-pod leases, the pre-r24 behavior). One lease per
+    host carries every pod registration with a single batched keepalive
+    write — per-host heartbeats instead of per-pod ones."""
+    if not config.env_flag("EDL_TPU_LEASE_COALESCE", False):
+        return None
+    return host_coalescer(store, socket.gethostname(), ttl)
 
 
 def ranks_prefix(job_id: str) -> str:
@@ -60,7 +73,8 @@ class PodRegister:
     """
 
     def __init__(self, store: Store, job_id: str, pod: Pod,
-                 max_nodes: int = 1024, ttl: float = 10.0):
+                 max_nodes: int = 1024, ttl: float = 10.0,
+                 coalescer: HostLeaseCoalescer | None = None):
         self.store = store
         self.job_id = job_id
         self.pod = pod
@@ -69,6 +83,13 @@ class PodRegister:
         self.lease: int | None = None
         self.lost = threading.Event()
         self._keeper: LeaseKeeper | None = None
+        # Lease coalescing (doc/design_coord.md): with a coalescer the
+        # claim rides the HOST lease (one keepalive writer per host, not
+        # per pod) and release detaches just this pod's key — siblings
+        # on the shared lease are untouched.
+        self._coalescer = coalescer if coalescer is not None \
+            else default_coalescer(store, ttl)
+        self._claimed_key: str | None = None
 
     def claim(self, timeout: float = 60.0) -> int:
         """Race for the smallest free slot. Returns the claimed rank."""
@@ -77,23 +98,32 @@ class PodRegister:
         watch = None
         try:
             while time.monotonic() < deadline:
-                lease = self.store.lease_grant(self.ttl)
+                lease = self._coalescer.lease() \
+                    if self._coalescer is not None \
+                    else self.store.lease_grant(self.ttl)
                 for i in range(self.max_nodes):
                     self.pod.claimed_rank = i
                     if self.store.put_if_absent(rank_key(self.job_id, i),
                                                 self.pod.to_json(),
                                                 lease=lease):
                         self.lease = lease
-                        self._keeper = LeaseKeeper(
-                            self.store, lease, interval=self.ttl / 6.0,
-                            on_lost=self._on_lost).start()
+                        self._claimed_key = rank_key(self.job_id, i)
+                        if self._coalescer is not None:
+                            self._coalescer.attach(self._claimed_key,
+                                                   on_lost=self._on_lost)
+                        else:
+                            self._keeper = LeaseKeeper(
+                                self.store, lease, interval=self.ttl / 6.0,
+                                on_lost=self._on_lost).start()
                         log.info("pod %s claimed rank %d",
                                  self.pod.pod_id, i)
                         return i
                 # Every slot taken: revoke the unused lease and retry when
                 # a slot frees (its DELETE event wakes us; the 1s re-poll
                 # is the EDL_TPU_COORD_WATCH=0 / in-process fallback).
-                self.store.lease_revoke(lease)
+                # A coalesced host lease is shared — never revoke it here.
+                if self._coalescer is None:
+                    self.store.lease_revoke(lease)
                 if watch is None:
                     watch = try_watch(self.store, ranks_prefix(self.job_id))
                 if watch is not None:
@@ -117,6 +147,11 @@ class PodRegister:
                            self.pod.to_json(), lease=self.lease)
 
     def release(self) -> None:
+        if self._coalescer is not None and self._claimed_key is not None:
+            # per-pod revoke on the shared lease: delete only our key
+            self._coalescer.detach(self._claimed_key, delete=True)
+            self._claimed_key = None
+            self.lease = None
         if self._keeper is not None:
             self._keeper.stop(revoke=True)
             self._keeper = None

@@ -32,6 +32,7 @@ from collections import deque
 
 from edl_tpu.coord import wire
 from edl_tpu.coord.store import Event, Record, Store, Watch, WatchBatch
+from edl_tpu.obs import metrics
 from edl_tpu.obs import recorder as flight
 from edl_tpu.utils import config, exceptions
 from edl_tpu.utils.backoff import Backoff
@@ -121,28 +122,42 @@ class StoreClient(Store):
             self._cursor = 0
             self._preferred = None
 
-    def _connect(self) -> socket.socket:
+    def _connect_once(self) -> socket.socket:
+        """ONE pass over the candidate endpoints, no internal retry
+        loop. Callers that own a reconnect cadence (ClientWatch's
+        growing jittered backoff) use this so a dead server is dialed
+        once per backoff step — not ``connect_retries`` rounds per step,
+        which is the thundering herd the relay tier exists to absorb."""
         last: Exception | None = None
+        for ep in self._candidates():
+            try:
+                sock = socket.create_connection(
+                    split_endpoint(ep), timeout=self._timeout)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._note_connected(ep)
+                return sock
+            except OSError as exc:
+                last = exc
+                with self._ep_lock:
+                    if self._preferred == ep:
+                        # a leader hint that does not even accept a
+                        # connection is stale — stop chasing it
+                        self._preferred = None
+        raise EdlStoreError(
+            f"cannot connect to store at {self._endpoint}: {last}")
+
+    def _connect(self) -> socket.socket:
+        last: EdlStoreError | None = None
         backoff = Backoff(base=self._retry_interval,
                           max_delay=self._retry_interval * 2)
         for _ in range(self._connect_retries):
-            for ep in self._candidates():
-                try:
-                    sock = socket.create_connection(
-                        split_endpoint(ep), timeout=self._timeout)
-                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    self._note_connected(ep)
-                    return sock
-                except OSError as exc:
-                    last = exc
-                    with self._ep_lock:
-                        if self._preferred == ep:
-                            # a leader hint that does not even accept a
-                            # connection is stale — stop chasing it
-                            self._preferred = None
+            try:
+                return self._connect_once()
+            except EdlStoreError as exc:
+                last = exc
             backoff.sleep()
-        raise EdlStoreError(
-            f"cannot connect to store at {self._endpoint}: {last}")
+        raise last if last is not None else EdlStoreError(
+            f"cannot connect to store at {self._endpoint}")
 
     # Ops safe to re-send after a connection error. Mutating-but-idempotent
     # ops (put/delete) are included: re-applying them yields the same state.
@@ -282,15 +297,47 @@ class StoreClient(Store):
                 resp["compacted"])
 
     def watch(self, prefix: str = "", start_revision: int | None = None,
-              heartbeat: float = 2.0) -> "ClientWatch":
+              heartbeat: float = 2.0, via_relay: bool = True,
+              on_resume=None) -> "ClientWatch":
         """Long-lived watch stream on its own connection (the main
         socket stays strict request/response). Reconnects on any error
         and resumes from the last delivered revision, so events are
         delivered exactly once across server restarts — unless the
         server compacted past the resume point, in which case the
-        consumer receives an explicit ``compacted`` batch."""
+        consumer receives an explicit ``compacted`` batch.
+
+        With ``EDL_TPU_RELAY_ENDPOINTS`` set, watch streams dial the
+        relay tier instead of the store (same protocol, same resume
+        contract — coord/relay.py) so a fleet of watchers costs the
+        store one upstream stream per distinct prefix. ``via_relay=
+        False`` forces a direct stream — the relay itself uses it for
+        its upstream (never watch through yourself)."""
+        if via_relay:
+            relay_eps = config.env_str("EDL_TPU_RELAY_ENDPOINTS", "")
+            if relay_eps:
+                return self._relay_client(relay_eps).watch(
+                    prefix, start_revision, heartbeat=heartbeat,
+                    via_relay=False, on_resume=on_resume)
         return ClientWatch(self, prefix, start_revision,
-                           heartbeat=heartbeat)
+                           heartbeat=heartbeat, on_resume=on_resume)
+
+    def _relay_client(self, endpoints: str) -> "StoreClient":
+        """Lazily-built sibling client aimed at the relay tier (watch
+        streams only; everything else keeps talking to the store)."""
+        with self._ep_lock:
+            cached = getattr(self, "_relay", None)
+            if cached is not None and cached._endpoint == \
+                    ",".join(e for e in (p.strip()
+                                         for p in endpoints.split(","))
+                             if e):
+                return cached
+        relay = StoreClient(endpoints, timeout=self._timeout,
+                            connect_retries=self._connect_retries,
+                            retry_interval=self._retry_interval,
+                            max_hops=self._max_hops)
+        with self._ep_lock:
+            self._relay = relay
+        return relay
 
     def ping(self) -> bool:
         try:
@@ -318,10 +365,14 @@ class ClientWatch(Watch):
 
     def __init__(self, client: "StoreClient", prefix: str,
                  start_revision: int | None, *, heartbeat: float = 2.0,
-                 reconnect_backoff: float = 0.2):
+                 reconnect_backoff: float = 0.2, on_resume=None):
         self._client = client
         self.prefix = prefix
         self._heartbeat = heartbeat
+        # called with the resume revision after every successful
+        # RE-subscribe (not the first ack) — the relay uses it to leave
+        # a relay_resume trail in the flight recorder
+        self._on_resume = on_resume
         # shared jittered-exponential schedule (utils/backoff.py): a
         # fleet of watchers re-attaching after a leader kill must not
         # re-dial in lockstep
@@ -355,7 +406,11 @@ class ClientWatch(Watch):
         redirect_hops = 0
         while not self._stop.is_set():
             try:
-                sock = self._client._connect()
+                # _connect_once, not _connect: the jittered backoff at
+                # the bottom of THIS loop owns the retry cadence — the
+                # old path re-dialed a dead follower connect_retries
+                # times per reconnect attempt in a near-tight loop
+                sock = self._client._connect_once()
             except EdlStoreError:
                 if self._backoff.sleep(self._stop):
                     return
@@ -399,6 +454,11 @@ class ClientWatch(Watch):
                 if not first:
                     log.info("watch %r resumed from revision %d",
                              self.prefix, self._last_rev)
+                    if self._on_resume is not None:
+                        try:
+                            self._on_resume(self._last_rev)
+                        except Exception:  # noqa: BLE001 — observer only
+                            log.exception("watch on_resume callback failed")
                 first = False
                 while True:
                     msg = wire.recv_msg(sock)
@@ -509,3 +569,157 @@ class LeaseKeeper:
                 self.store.lease_revoke(self.lease)
             except EdlStoreError:
                 pass
+
+
+class HostLeaseCoalescer:
+    """One host-scoped lease carrying ALL of the host's pod
+    registrations, refreshed by a single keepalive write per interval.
+
+    40 pods per host means 40x fewer keepalive writes hitting the
+    leader than per-pod leases — the multiplier the 100k-pod control
+    plane needs (doc/design_coord.md). The TTL contract is unchanged:
+    each keepalive re-arms deadline = now + ttl, never further, so
+    coalescing reduces WRITE volume, not failure-detection latency.
+    If the host lease expires, the store sweeps every attached
+    registration in one event batch (store._expire emits per-lease
+    batches) and each pod's ``on_lost`` callback fires here.
+
+    - ``attach(key, on_lost)`` -> lease id to put the key under.
+    - ``detach(key, delete=True)`` -> per-pod revoke: deletes only that
+      key; siblings on the shared lease are untouched. The host lease
+      itself is revoked when the last key detaches.
+    """
+
+    def __init__(self, store: Store, host_id: str, ttl: float = 10.0,
+                 interval: float | None = None):
+        self.store = store
+        self.host_id = host_id
+        self.ttl = ttl
+        self.interval = interval if interval is not None \
+            else max(0.05, ttl / 6.0)
+        self._lock = threading.RLock()
+        self._lease = 0                 # guarded-by: _lock
+        self._attached: dict[str, object] = {}  # key -> on_lost|None
+        self._stop = threading.Event()  # replaced per lease generation
+        self.keepalives_sent = 0        # guarded-by: _lock
+        self.leases_lost = 0            # guarded-by: _lock
+        self.closed = False             # guarded-by: _lock
+        self._obs = metrics.register_stats("lease_coalescer", self.stats)
+
+    def lease(self) -> int:
+        """The host lease id (granted + keepalive thread started on
+        first use; re-granted after a loss)."""
+        with self._lock:
+            if self.closed:
+                raise EdlStoreError(
+                    f"lease coalescer for {self.host_id} is closed")
+            if self._lease == 0:
+                self._lease = self.store.lease_grant(self.ttl)
+                self._stop = threading.Event()
+                threading.Thread(
+                    target=self._run, args=(self._lease, self._stop),
+                    daemon=True,
+                    name=f"host-lease-{self.host_id}").start()
+            return self._lease
+
+    def attach(self, key: str, on_lost=None) -> int:
+        with self._lock:
+            lease = self.lease()
+            self._attached[key] = on_lost
+            return lease
+
+    def detach(self, key: str, delete: bool = False) -> None:
+        with self._lock:
+            self._attached.pop(key, None)
+            empty = not self._attached and self._lease
+        if delete:
+            try:
+                self.store.delete(key)
+            except EdlStoreError:
+                log.warning("coalescer detach: delete %r failed", key)
+        if empty:
+            self._retire()
+
+    def _retire(self) -> None:
+        with self._lock:
+            if self._attached or not self._lease:
+                return
+            lease, self._lease = self._lease, 0
+            self._stop.set()
+        try:
+            self.store.lease_revoke(lease)
+        except EdlStoreError:
+            pass  # ttl expiry collects it
+
+    def _run(self, lease: int, stop: threading.Event) -> None:
+        while not stop.wait(self.interval):
+            try:
+                alive = self.store.lease_keepalive(lease)
+            except EdlStoreError as exc:
+                log.warning("host lease %d keepalive error: %s", lease, exc)
+                continue
+            with self._lock:
+                self.keepalives_sent += 1
+            if not alive:
+                if not stop.is_set():
+                    self._on_host_lost(lease)
+                return
+
+    def _on_host_lost(self, lease: int) -> None:
+        with self._lock:
+            if self._lease != lease:
+                return  # already retired / re-granted
+            self._lease = 0
+            attached = dict(self._attached)
+            self._attached.clear()
+            self.leases_lost += 1
+        flight.record("lease_host_expire", host=self.host_id,
+                      lease=lease, keys=len(attached))
+        log.error("host lease %d (%s) lost: %d registrations swept",
+                  lease, self.host_id, len(attached))
+        for key, cb in attached.items():
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001 — observer callbacks
+                    log.exception("on_lost callback for %r failed", key)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"host": self.host_id,
+                    "lease_batch_size": len(self._attached),
+                    "keepalives_sent": self.keepalives_sent,
+                    "leases_lost": self.leases_lost,
+                    "active": 1 if self._lease else 0}
+
+    def close(self, revoke: bool = True) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+            self._attached.clear()
+            lease, self._lease = self._lease, 0
+            self._stop.set()
+        if revoke and lease:
+            try:
+                self.store.lease_revoke(lease)
+            except EdlStoreError:
+                pass
+        metrics.unregister(self._obs)
+
+
+_coalescers: dict[tuple[int, str], HostLeaseCoalescer] = {}
+_coalescer_lock = threading.Lock()
+
+
+def host_coalescer(store: Store, host_id: str,
+                   ttl: float = 10.0) -> HostLeaseCoalescer:
+    """Process-wide coalescer per (store, host): every PodRegister on
+    the host shares one lease + one keepalive thread."""
+    with _coalescer_lock:
+        key = (id(store), host_id)
+        co = _coalescers.get(key)
+        if co is None or co.closed:
+            co = HostLeaseCoalescer(store, host_id, ttl)
+            _coalescers[key] = co
+        return co

@@ -100,6 +100,11 @@ _KEY_OPS = frozenset({"put", "get", "delete", "put_if_absent", "cas"})
 _PREFIX_OPS = frozenset({"get_prefix", "delete_prefix", "events_since",
                          "watch"})
 _SIDE_LOG_MAX = 4096
+# leader-side log compaction: once every peer has acked past the floor,
+# compact history up to it every _COMPACT_EVERY revisions, keeping a
+# _COMPACT_KEEP-event resume cushion for late watch resumers
+_COMPACT_EVERY = 2048
+_COMPACT_KEEP = 512
 
 
 def election_ttl_default() -> float:
@@ -361,6 +366,9 @@ class ReplicaNode:
         self._elections_won = 0            # guarded-by: _state_lock
         self._step_downs = 0               # guarded-by: _state_lock
         self._snapshot_installs = 0        # guarded-by: _state_lock
+        self._delta_installs = 0           # guarded-by: _state_lock
+        # leader-side log compaction floor (last revision compacted to)
+        self._compact_floor = 0            # guarded-by: _commit_cond
         self._obs = obs_metrics.register_stats("replica", self.stats)
         self.store.set_passive(True)
         # Commit-gated watch fan-out: a replicated store's watchers
@@ -399,6 +407,7 @@ class ReplicaNode:
                     "elections_won": self._elections_won,
                     "step_downs": self._step_downs,
                     "snapshot_installs": self._snapshot_installs,
+                    "delta_installs": self._delta_installs,
                     "peers": len(self.peers)}
 
     def stop(self, graceful: bool = True) -> None:
@@ -680,10 +689,23 @@ class ReplicaNode:
             self._match[peer] = max(self._match.get(peer, 0), rev)
             self._recompute_commit_locked()
             commit = self._commit_rev
+            # log-compaction floor: the lowest revision ANY peer has
+            # acked — history below it only serves late watch resumers
+            floor = min((self._match.get(p, 0) for p in self.peers),
+                        default=commit)
+            floor = min(floor, commit)
+            compact_to = 0
+            if floor - self._compact_floor >= _COMPACT_EVERY:
+                self._compact_floor = compact_to = floor
         # commit advanced (or held): release watch fan-out up to it —
         # outside the condition so the lock order stays commit_cond ->
         # store lock in one direction only
         self.store.release_fanout(commit)
+        if compact_to:
+            dropped = self.store.compact(compact_to, keep=_COMPACT_KEEP)
+            if dropped:
+                log.debug("leader %s compacted %d events (<= rev %d)",
+                          self.endpoint, dropped, compact_to)
 
     def _advance_fanout(self) -> None:
         """Recompute the commit point and release watch fan-out to it."""
@@ -796,17 +818,36 @@ class ReplicaNode:
 
     def _send_snapshot(self, sock: socket.socket, term: int
                        ) -> tuple[int, int]:
-        state = self.store.snapshot_state()
-        resp = self._roundtrip(sock, {
-            "op": "repl_snapshot", "term": term, "leader": self.endpoint,
-            "state": state})
+        """Ship catch-up state: delta-compressed against the peer's
+        digest when it answers one (only divergent/missing records
+        cross the wire — fast rejoin for a briefly-dirty ex-leader
+        whose keyspace is 99% identical), full state otherwise."""
+        msg: dict = {"op": "repl_snapshot", "term": term,
+                     "leader": self.endpoint}
+        revision = None
+        try:
+            dig = self._roundtrip(sock, {
+                "op": "repl_digest", "term": term, "leader": self.endpoint})
+            if self._check_stale(dig):
+                raise EdlStoreError("deposed during digest exchange")
+            if dig.get("ok") and dig.get("digest") is not None:
+                delta = self.store.snapshot_delta(dig["digest"])
+                msg["delta"] = delta
+                revision = int(delta["revision"])
+        except (KeyError, TypeError, ValueError):
+            pass  # malformed digest: fall through to a full snapshot
+        if revision is None:
+            state = self.store.snapshot_state()
+            msg["state"] = state
+            revision = int(state["revision"])
+        resp = self._roundtrip(sock, msg)
         if self._check_stale(resp):
             raise EdlStoreError("deposed during snapshot install")
         if not resp.get("ok"):
             raise EdlStoreError(str(resp.get("error")))
         with self._side_lock:
             seq = self._side_seq
-        return int(state["revision"]), seq
+        return revision, seq
 
     def _check_stale(self, resp: dict) -> bool:
         if resp.get("stale_term"):
@@ -897,20 +938,35 @@ class ReplicaNode:
         return {"ok": True, "revision": self.store.current_revision,
                 "term": self.term()}
 
+    def _handle_digest(self, req: dict) -> dict:
+        rejection = self._accept_leader(int(req.get("term", 0)),
+                                        str(req.get("leader", "")))
+        if rejection is not None:
+            return rejection
+        return {"ok": True, "digest": self.store.state_digest(),
+                "term": self.term()}
+
     def _handle_snapshot(self, req: dict) -> dict:
         rejection = self._accept_leader(int(req.get("term", 0)),
                                         str(req.get("leader", "")))
         if rejection is not None:
             return rejection
-        self.store.install_snapshot(req.get("state") or {})
+        delta = req.get("delta")
+        if delta is not None:
+            self.store.install_snapshot_delta(delta)
+        else:
+            self.store.install_snapshot(req.get("state") or {})
         with self._state_lock:
             self._dirty = False
             self._snapshot_installs += 1
+            if delta is not None:
+                self._delta_installs += 1
         flight.record("snapshot_install", replica=self.endpoint,
-                      group=self.group,
+                      group=self.group, delta=delta is not None,
                       revision=self.store.current_revision)
-        log.info("replica %s installed snapshot at revision %d",
-                 self.endpoint, self.store.current_revision)
+        log.info("replica %s installed %s snapshot at revision %d",
+                 self.endpoint, "delta" if delta is not None else "full",
+                 self.store.current_revision)
         return {"ok": True, "revision": self.store.current_revision,
                 "term": self.term()}
 
@@ -928,13 +984,16 @@ class ReplicaNode:
                 return {"ok": False,
                         "error": f"op {op!r} unsupported in elect space"}
             return _Handler._dispatch(self.elect, sub)
-        if op in ("repl_probe", "repl_append", "repl_snapshot"):
+        if op in ("repl_probe", "repl_append", "repl_digest",
+                  "repl_snapshot"):
             if self._blocked(str(req.get("leader") or "") or None):
                 return {"ok": False, "error": "partitioned (chaos hook)"}
             if op == "repl_probe":
                 return self._handle_probe(req)
             if op == "repl_append":
                 return self._handle_append(req)
+            if op == "repl_digest":
+                return self._handle_digest(req)
             return self._handle_snapshot(req)
         if op == "status":
             return self.status_doc()

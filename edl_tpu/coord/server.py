@@ -114,11 +114,34 @@ class _Handler(socketserver.BaseRequestHandler):
                     msg = {"ok": True, "events": [], "revision": rev,
                            "compacted": False}
                 else:
+                    # merge whatever else is already queued into this
+                    # frame (up to the wire ceiling): under a burst the
+                    # stream ships a few big frames instead of thousands
+                    # of one-event ones. A compacted batch is never
+                    # merged — it is a resync signal, not events — so it
+                    # ships alone right after.
+                    events = list(batch.events)
+                    revision = batch.revision
+                    tail = None
+                    while not batch.compacted \
+                            and len(events) < wire.MAX_EVENTS_PER_FRAME:
+                        nxt = watch.get(timeout=0)
+                        if nxt is None:
+                            break
+                        if nxt.compacted:
+                            tail = nxt
+                            break
+                        events.extend(nxt.events)
+                        revision = nxt.revision
                     msg = {"ok": True,
                            "events": [[e.type, e.key, e.value, e.revision]
-                                      for e in batch.events],
-                           "revision": batch.revision,
+                                      for e in events],
+                           "revision": revision,
                            "compacted": batch.compacted}
+                    if tail is not None:
+                        wire.send_msg(sock, msg)
+                        msg = {"ok": True, "events": [],
+                               "revision": tail.revision, "compacted": True}
                 wire.send_msg(sock, msg)
         except OSError:
             return

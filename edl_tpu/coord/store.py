@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -161,17 +162,24 @@ class InMemWatch(Watch):
     # -- producer side (store lock held) ------------------------------------
 
     def _push(self, ev: Event) -> None:
+        self._push_events((ev,))
+
+    def _push_events(self, evs: tuple[Event, ...]) -> None:
+        """Range-batched delivery: one WatchBatch — one revision header
+        on the wire — carrying every event of a multi-key mutation
+        (lease-expiry sweep, delete_prefix, a commit-gate release)
+        instead of one batch per event."""
         with self._cond:
-            if self._cancelled:
+            if self._cancelled or not evs:
                 return
-            if self._pending_events >= self._max:
+            if self._pending_events + len(evs) > self._max:
                 # lagging consumer: drop everything, force a resync
                 self._queue.clear()
                 self._pending_events = 0
-                self._queue.append(WatchBatch((), ev.revision, True))
+                self._queue.append(WatchBatch((), evs[-1].revision, True))
             else:
-                self._pending_events += 1
-                self._queue.append(WatchBatch((ev,), ev.revision))
+                self._pending_events += len(evs)
+                self._queue.append(WatchBatch(tuple(evs), evs[-1].revision))
             self._cond.notify_all()
 
     def _push_compacted(self, revision: int) -> None:
@@ -315,6 +323,9 @@ class InMemStore(Store):
         self._gated = False                   # guarded-by: _lock
         self._gate_rev = 0                    # guarded-by: _lock
         self._pending_fanout: deque[Event] = deque()  # guarded-by: _lock
+        # log-compaction + delta-snapshot accounting
+        self._events_compacted = 0            # guarded-by: _lock
+        self._delta_snapshots = 0             # guarded-by: _lock
 
     # -- internals ---------------------------------------------------------
 
@@ -323,22 +334,40 @@ class InMemStore(Store):
         return self._revision
 
     def _emit(self, ev: Event) -> None:  # holds-lock: _lock
-        self._events.append(ev)
+        self._emit_many([ev])
+
+    def _emit_many(self, evs: list[Event]) -> None:  # holds-lock: _lock
+        """Append + fan out a multi-event mutation as ONE WatchBatch per
+        watcher (range-batched event frames) instead of one per event —
+        a host-lease expiry sweeping 40 pod registrations costs each
+        watcher one queue append, not 40."""
+        if not evs:
+            return
+        self._events.extend(evs)
         if len(self._events) > self._max_events:
             drop = len(self._events) - self._max_events
             self._first_event_rev = self._events[drop].revision
             del self._events[:drop]
-        if self._gated and ev.revision > self._gate_rev:
-            self._pending_fanout.append(ev)
+        if self._gated:
+            ready = [ev for ev in evs if ev.revision <= self._gate_rev]
+            self._pending_fanout.extend(
+                ev for ev in evs if ev.revision > self._gate_rev)
+            if ready:
+                self._fanout_push_many(ready)
             return
-        self._fanout_push(ev)
+        self._fanout_push_many(evs)
 
     def _fanout_push(self, ev: Event) -> None:  # holds-lock: _lock
+        self._fanout_push_many([ev])
+
+    def _fanout_push_many(self, evs: list[Event]) -> None:  # holds-lock: _lock
         for watcher in self._watchers:
-            if ev.key.startswith(watcher.prefix) \
-                    and ev.revision > watcher.min_revision:
-                watcher._push(ev)
-                self._fanout_events += 1
+            fit = [ev for ev in evs
+                   if ev.key.startswith(watcher.prefix)
+                   and ev.revision > watcher.min_revision]
+            if fit:
+                watcher._push_events(tuple(fit))
+                self._fanout_events += len(fit)
 
     def _expire(self) -> None:  # holds-lock: _lock
         if self._passive:
@@ -347,11 +376,16 @@ class InMemStore(Store):
         dead = [l for l in self._leases.values() if l.deadline <= now]
         self._expired_leases += len(dead)
         for lease in dead:
+            # one event batch per expired lease: every key the lease
+            # carried (a whole host's pod registrations under lease
+            # coalescing) sweeps in a single delivery
+            evs = []
             for key in sorted(lease.keys):
                 rec = self._data.pop(key, None)
                 if rec is not None:
-                    self._emit(Event("DELETE", key, rec.value, self._bump()))
+                    evs.append(Event("DELETE", key, rec.value, self._bump()))
             del self._leases[lease.id]
+            self._emit_many(evs)
 
     def _check_lease(self, lease: int) -> None:  # holds-lock: _lock
         if lease and lease not in self._leases:
@@ -411,10 +445,12 @@ class InMemStore(Store):
             self.op_count += 1
             self._expire()
             keys = [k for k in self._data if k.startswith(prefix)]
+            evs = []
             for k in keys:
                 rec = self._data.pop(k)
                 self._detach(k, rec)
-                self._emit(Event("DELETE", k, rec.value, self._bump()))
+                evs.append(Event("DELETE", k, rec.value, self._bump()))
+            self._emit_many(evs)
             return len(keys)
 
     def put_if_absent(self, key: str, value: str, lease: int = 0) -> bool:
@@ -471,10 +507,12 @@ class InMemStore(Store):
             entry = self._leases.pop(lease, None)
             if entry is None:
                 return False
+            evs = []
             for key in sorted(entry.keys):
                 rec = self._data.pop(key, None)
                 if rec is not None:
-                    self._emit(Event("DELETE", key, rec.value, self._bump()))
+                    evs.append(Event("DELETE", key, rec.value, self._bump()))
+            self._emit_many(evs)
             return True
 
     def events_since(self, revision: int, prefix: str = ""
@@ -515,8 +553,9 @@ class InMemStore(Store):
             self._gated = gated
             self._gate_rev = self._revision
             if not gated:
-                while self._pending_fanout:
-                    self._fanout_push(self._pending_fanout.popleft())
+                flush = list(self._pending_fanout)
+                self._pending_fanout.clear()
+                self._fanout_push_many(flush)
 
     @property
     def fanout_gated(self) -> bool:
@@ -534,9 +573,13 @@ class InMemStore(Store):
             if revision <= self._gate_rev:
                 return
             self._gate_rev = revision
+            ready = []
             while self._pending_fanout \
                     and self._pending_fanout[0].revision <= revision:
-                self._fanout_push(self._pending_fanout.popleft())
+                ready.append(self._pending_fanout.popleft())
+            # one batch for the whole released range: a commit covering
+            # N entries reaches each watcher as one frame, not N
+            self._fanout_push_many(ready)
 
     def _visible_revision_locked(self) -> int:  # holds-lock: _lock
         """The revision watchers may use as a resume anchor: everything
@@ -565,10 +608,11 @@ class InMemStore(Store):
                     # when the commit gate advances over it
                     horizon = self._gate_rev if self._gated \
                         else self._revision
-                    for ev in self._events:
-                        if start_revision < ev.revision <= horizon \
-                                and ev.key.startswith(prefix):
-                            watcher._push(ev)
+                    replay = tuple(
+                        ev for ev in self._events
+                        if start_revision < ev.revision <= horizon
+                        and ev.key.startswith(prefix))
+                    watcher._push_events(replay)
             self._watchers.append(watcher)
             return watcher
 
@@ -596,9 +640,28 @@ class InMemStore(Store):
                     "watchers": len(self._watchers),
                     "watch_fanout_events": self._fanout_events,
                     "events_buffered": len(self._events),
+                    "events_compacted": self._events_compacted,
+                    "delta_snapshots": self._delta_snapshots,
                     "fanout_gated": self._gated,
                     "fanout_pending": len(self._pending_fanout),
                     "passive": self._passive}
+
+    def compact(self, revision: int, keep: int = 512) -> int:
+        """Drop event history at or below ``revision``, always retaining
+        the newest ``keep`` events as a resume cushion. Watchers resumed
+        below the new floor get the normal ``compacted`` resync; the
+        leader calls this once every peer's match revision has passed
+        the compaction point, so healthy followers never pay it."""
+        with self._lock:
+            cut = 0
+            limit = max(0, len(self._events) - max(0, keep))
+            while cut < limit and self._events[cut].revision <= revision:
+                cut += 1
+            if cut:
+                self._first_event_rev = self._events[cut].revision
+                del self._events[:cut]
+                self._events_compacted += cut
+            return cut
 
     # -- replication raw-apply (coord/replication.py) ------------------------
     #
@@ -703,6 +766,72 @@ class InMemStore(Store):
                             for r in self._data.values()],
                 "leases": [[l.id, l.ttl] for l in self._leases.values()],
             }
+
+    def state_digest(self) -> dict:
+        """Compact fingerprint of local state for delta-snapshot
+        negotiation: per-key [key, revision, crc32(value)]. The value
+        crc matters — a dirty ex-leader can hold the SAME revision
+        number with a DIFFERENT value (uncommitted suffix, revisions
+        reused by the next reign), so revision equality alone would
+        silently keep divergent records."""
+        with self._lock:
+            return {
+                "revision": self._revision,
+                "keys": [[r.key, r.revision,
+                          zlib.crc32(r.value.encode("utf-8"))]
+                         for r in self._data.values()],
+            }
+
+    def snapshot_delta(self, digest: dict) -> dict:
+        """Delta-compressed snapshot against a follower's digest: only
+        records the follower lacks or holds divergently (``set``), plus
+        keys it must drop (``del``). Leases ship in full — the table is
+        tiny next to the keyspace. ``base`` records the digest size the
+        delta was computed against (observability only)."""
+        with self._lock:
+            theirs = {row[0]: (int(row[1]), int(row[2]))
+                      for row in digest.get("keys", ())}
+            set_rows = []
+            for key, rec in self._data.items():
+                have = theirs.get(key)
+                if have is None or have != (
+                        rec.revision,
+                        zlib.crc32(rec.value.encode("utf-8"))):
+                    set_rows.append([rec.key, rec.value, rec.revision,
+                                     rec.lease])
+            del_keys = [k for k in theirs if k not in self._data]
+            return {
+                "revision": self._revision,
+                "set": set_rows,
+                "del": del_keys,
+                "leases": [[l.id, l.ttl] for l in self._leases.values()],
+                "base": len(theirs),
+            }
+
+    def install_snapshot_delta(self, doc: dict) -> None:
+        """Apply a delta snapshot over current state. Same watcher
+        contract as a full install: history before the snapshot
+        revision is unknowable, so every local watcher gets a
+        ``compacted`` resync batch."""
+        with self._lock:
+            for key in doc.get("del", ()):
+                self._data.pop(key, None)
+            for row in doc.get("set", ()):
+                self._data[row[0]] = Record(row[0], row[1], row[2], row[3])
+            self._leases = {}
+            now = self._clock()
+            for lease_id, ttl in doc.get("leases", ()):
+                self._leases[lease_id] = _Lease(lease_id, ttl, now + ttl)
+                self._next_lease = max(self._next_lease, lease_id + 1)
+            # lease->keys index rebuilds on promotion (set_passive)
+            self._revision = max(self._revision, int(doc.get("revision", 0)))
+            self._events = []
+            self._first_event_rev = self._revision + 1
+            self._pending_fanout.clear()
+            self._gate_rev = self._revision
+            self._delta_snapshots += 1
+            for watcher in self._watchers:
+                watcher._push_compacted(self._revision)
 
     def install_snapshot(self, doc: dict) -> None:
         """Replace local state wholesale (lagging or divergent follower).

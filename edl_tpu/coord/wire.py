@@ -17,9 +17,14 @@ connection into a long-lived server-push stream:
     server -> {"ok": true, "events": [[type, key, value, revision], ...],
                "revision": int, "compacted": bool}              # repeated
 
-Event frames are pushed as mutations happen; an empty ``events`` frame is a
-heartbeat (sent every ``heartbeat`` seconds when idle) whose ``revision``
-advances the client's resume anchor and doubles as liveness. A frame with
+Event frames are **range-batched**: one frame carries up to
+``MAX_EVENTS_PER_FRAME`` revision-ordered events under a single
+``revision`` header (the resume anchor of the LAST event in the frame) —
+a multi-key mutation (lease-expiry sweep, delete_prefix, a commit-gate
+release) or a burst against a lagging consumer costs one header + one
+syscall, not one per event. An empty ``events`` frame is a heartbeat
+(sent every ``heartbeat`` seconds when idle) whose ``revision`` advances
+the client's resume anchor and doubles as liveness. A frame with
 ``compacted: true`` means events were lost (history compaction or a lagging
 watcher queue): the client must resync with ``get_prefix`` and may resume
 from that frame's revision. There is no cancel op — the client closes the
@@ -39,7 +44,9 @@ may re-route even non-idempotent ops (put_if_absent/cas) safely:
                                            # group (SURVEY C3's REDIRECT)
 
 Replica peers also exchange ``repl_probe`` / ``repl_append`` /
-``repl_snapshot`` / ``status`` ops over the same frames (schema in
+``repl_digest`` / ``repl_snapshot`` / ``status`` ops over the same frames
+(``repl_digest`` answers a per-key [key, revision, crc32] fingerprint so
+the leader can ship a delta-compressed ``repl_snapshot``; schema in
 coord/replication.py). ``elect_space: true`` on a request routes it to
 the replica's ALWAYS-ACTIVE election sidecar store instead of the
 replicated data store — the election substrate must keep expiring
@@ -63,6 +70,10 @@ from edl_tpu.utils import config
 MAGIC = b"EDL1"
 _HEADER = struct.Struct(">4sI")
 MAX_BODY = 64 * 1024 * 1024
+# ceiling on events coalesced into one watch push frame: bounds frame
+# size (and a consumer's catch-up stall) while keeping the per-frame
+# header/syscall cost amortized across a burst
+MAX_EVENTS_PER_FRAME = 512
 
 
 class WireError(ConnectionError):

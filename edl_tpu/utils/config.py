@@ -124,6 +124,14 @@ ENV_VARS: dict[str, str] = {
     "EDL_TPU_WATCH_RESYNC_S": "resync safety-net period for event-driven "
                               "consumers",
     "EDL_TPU_PUBLISH_UTIL": "trainer utilization publishing (0 = off)",
+    "EDL_TPU_RELAY_ENDPOINTS": "watch relay tier endpoints (comma-joined); "
+                               "when set, StoreClient.watch streams dial "
+                               "the relay instead of the store",
+    "EDL_TPU_RELAY_BUFFER": "relay per-prefix replay-history length "
+                            "(events kept for late/resuming downstreams)",
+    "EDL_TPU_LEASE_COALESCE": "host-scoped lease coalescing: one lease + "
+                              "one keepalive writer carries all of a "
+                              "host's pod registrations (0 = per-pod)",
     # -- autoscaler (trainer worlds) ---------------------------------------
     "EDL_TPU_SCALER_INTERVAL": "fallback decision interval seconds",
     "EDL_TPU_SCALER_MIN_TICK": "floor between event-triggered passes",

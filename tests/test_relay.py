@@ -1,0 +1,194 @@
+"""Watch relay tier (coord/relay.py): per-prefix upstream coalescing,
+the revision-resume fence, relay-death-equals-restart, and the
+range-batched frame path the relay rides on.
+
+The relay's contract is the store watch contract, unchanged through an
+extra hop — so these tests drive it with the same StoreClient the
+fleet uses, over real sockets.
+"""
+
+import time
+
+import pytest
+
+from edl_tpu.coord.client import StoreClient
+from edl_tpu.coord.relay import RelayServer, WatchRelay
+from edl_tpu.coord.server import StoreServer
+from edl_tpu.coord.store import InMemStore
+
+
+@pytest.fixture
+def server():
+    with StoreServer(port=0, host="127.0.0.1") as srv:
+        yield srv
+
+
+@pytest.fixture
+def relay_server(server):
+    rs = RelayServer(f"127.0.0.1:{server.port}", port=0,
+                     host="127.0.0.1").start()
+    yield rs
+    rs.stop()
+
+
+@pytest.fixture
+def store(server):
+    c = StoreClient(f"127.0.0.1:{server.port}", timeout=5.0)
+    yield c
+    c.close()
+
+
+def _drain(watch, want, timeout=10.0):
+    evs = []
+    deadline = time.monotonic() + timeout
+    while len(evs) < want and time.monotonic() < deadline:
+        batch = watch.get(timeout=0.25)
+        if batch is not None:
+            evs.extend(batch.events)
+    return evs
+
+
+def test_relay_fans_out_one_upstream_per_prefix(server, relay_server,
+                                                store):
+    relay_ep = f"127.0.0.1:{relay_server.port}"
+    downs = [StoreClient(relay_ep, timeout=5.0) for _ in range(3)]
+    w1 = downs[0].watch("/a/", via_relay=False)
+    w2 = downs[1].watch("/a/", via_relay=False)
+    wb = downs[2].watch("/b/", via_relay=False)
+    try:
+        revs = [store.put(f"/a/{i}", str(i)) for i in range(5)]
+        store.put("/b/x", "y")
+        assert [e.revision for e in _drain(w1, 5)] == revs
+        assert [e.revision for e in _drain(w2, 5)] == revs
+        got_b = _drain(wb, 1)
+        assert [e.key for e in got_b] == ["/b/x"]
+        stats = relay_server.relay.stats()
+        # 3 downstream streams, but only 2 distinct prefixes upstream
+        assert stats["relay_upstream_streams"] == 2
+        assert stats["relay_downstreams"] == 3
+    finally:
+        for w in (w1, w2, wb):
+            w.cancel()
+        for d in downs:
+            d.close()
+
+
+def test_relay_min_revision_fence(server, relay_server, store):
+    relay_ep = f"127.0.0.1:{relay_server.port}"
+    revs = [store.put(f"/f/{i}", str(i)) for i in range(8)]
+    c = StoreClient(relay_ep, timeout=5.0)
+    # resume mid-history: nothing at or below the anchor re-delivers
+    w = c.watch("/f/", start_revision=revs[4], via_relay=False)
+    try:
+        got = _drain(w, 3)
+        assert [e.revision for e in got] == revs[5:]
+    finally:
+        w.cancel()
+        c.close()
+
+
+def test_relay_stale_resume_answers_compacted(server, store):
+    for i in range(6):
+        store.put(f"/s/{i}", str(i))
+    relay = WatchRelay(f"127.0.0.1:{server.port}", buffer=64)
+    try:
+        anchored = relay.attach("/s/")          # pins the stream window
+        stale = relay.attach("/s/", start_revision=0)
+        batch = stale.get(timeout=5.0)
+        assert batch is not None and batch.compacted
+        anchored.cancel()
+        stale.cancel()
+    finally:
+        relay.close()
+
+
+def test_relay_restart_resumes_zero_lost_zero_dup(server, store):
+    ep = f"127.0.0.1:{server.port}"
+    rs = RelayServer(ep, port=0, host="127.0.0.1").start()
+    relay_ep = f"127.0.0.1:{rs.port}"
+    c = StoreClient(relay_ep, timeout=5.0)
+    w = c.watch("/k/", via_relay=False)
+    try:
+        revs1 = [store.put(f"/k/{i}", str(i)) for i in range(4)]
+        assert [e.revision for e in _drain(w, 4)] == revs1
+        port = rs.port
+        rs.stop()                       # the relay dies mid-stream
+        revs2 = [store.put(f"/k/{i}", str(i)) for i in range(4, 8)]
+        rs = RelayServer(ep, port=port, host="127.0.0.1").start()
+        # downstream reconnects + resumes by revision: exactly the gap
+        got = _drain(w, 4, timeout=20.0)
+        assert [e.revision for e in got] == revs2
+    finally:
+        w.cancel()
+        c.close()
+        rs.stop()
+
+
+def test_relay_endpoints_env_reroutes_watch(server, relay_server, store,
+                                            monkeypatch):
+    monkeypatch.setenv("EDL_TPU_RELAY_ENDPOINTS",
+                       f"127.0.0.1:{relay_server.port}")
+    c = StoreClient(f"127.0.0.1:{server.port}", timeout=5.0)
+    w = c.watch("/r/")  # via_relay defaults True -> dials the relay
+    try:
+        rev = store.put("/r/x", "1")
+        got = _drain(w, 1)
+        assert [e.revision for e in got] == [rev]
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if relay_server.relay.stats()["relay_downstreams"] == 1:
+                break
+            time.sleep(0.05)
+        assert relay_server.relay.stats()["relay_downstreams"] == 1
+    finally:
+        w.cancel()
+        c.close()
+
+
+def test_watch_replay_is_one_batched_frame():
+    # Range-batched frames: a watch replay carries every queued event
+    # under ONE revision header, not one frame per event.
+    s = InMemStore()
+    revs = [s.put(f"/b/{i:02d}", str(i)) for i in range(10)]
+    w = s.watch("/b/", start_revision=0)
+    batch = w.get(timeout=1.0)
+    assert batch is not None
+    assert len(batch.events) == 10
+    assert batch.revision == revs[-1]
+    w.cancel()
+
+
+def test_delete_prefix_emits_one_batch():
+    s = InMemStore()
+    for i in range(6):
+        s.put(f"/d/{i}", str(i))
+    w = s.watch("/d/")
+    s.delete_prefix("/d/")
+    batch = w.get(timeout=1.0)
+    assert batch is not None
+    assert len(batch.events) == 6
+    assert all(e.type == "DELETE" for e in batch.events)
+    w.cancel()
+
+
+def test_client_watch_reconnect_backs_off(server):
+    # A dead endpoint must be re-dialed through the jittered backoff,
+    # not hammered: count dials over a fixed window.
+    c = StoreClient(f"127.0.0.1:{server.port}", timeout=1.0,
+                    connect_retries=1, retry_interval=0.01)
+    w = c.watch("/bo/", heartbeat=5.0)
+    dials = []
+    orig = c._connect_once
+
+    def spy(*a, **k):
+        dials.append(time.monotonic())
+        return orig(*a, **k)
+
+    c._connect_once = spy
+    server.stop()
+    time.sleep(1.5)
+    w.cancel()
+    c.close()
+    # a hammer loop would dial hundreds of times in 1.5s; backoff keeps
+    # it to a handful
+    assert 1 <= len(dials) <= 20

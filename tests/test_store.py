@@ -144,3 +144,81 @@ def test_event_compaction(clock):
     evs, _, compacted = store.events_since(rev - 2)
     assert not compacted
     assert len(evs) == 2
+
+
+def test_compact_trims_history_and_forces_resync(store):
+    revs = [store.put(f"/c/{i}", str(i)) for i in range(20)]
+    dropped = store.compact(revs[9], keep=4)
+    assert dropped == 10
+    # below the floor: compacted resync
+    _, _, compacted = store.events_since(revs[4])
+    assert compacted
+    # at or above the floor: normal resume
+    evs, _, compacted = store.events_since(revs[9])
+    assert not compacted
+    assert len(evs) == 10
+    # the resume cushion is honoured: compacting "everything" keeps 4
+    store.compact(revs[-1], keep=4)
+    evs, _, compacted = store.events_since(revs[-5])
+    assert not compacted
+    assert len(evs) == 4
+
+
+def test_delta_snapshot_round_trip(clock):
+    leader = InMemStore(clock=clock)
+    follower = InMemStore(clock=clock)
+    for i in range(6):
+        leader.put(f"/d/{i}", str(i))
+    # follower holds a stale copy of /d/0 and an orphan the leader
+    # never had
+    follower.apply_put("/d/0", "stale", 1)
+    follower.apply_put("/zombie", "x", 2)
+    delta = leader.snapshot_delta(follower.state_digest())
+    assert "/zombie" in delta["del"]
+    assert len(delta["set"]) == 6          # /d/0 diverged + 5 missing
+    follower.install_snapshot_delta(delta)
+    assert follower.state_digest() == leader.state_digest()
+    assert follower.get("/zombie") is None
+    assert follower.get("/d/0").value == "0"
+
+
+def test_delta_snapshot_skips_matching_records(clock):
+    leader = InMemStore(clock=clock)
+    for i in range(8):
+        leader.put(f"/m/{i}", str(i))
+    follower = InMemStore(clock=clock)
+    follower.install_snapshot(leader.snapshot_state())
+    delta = leader.snapshot_delta(follower.state_digest())
+    assert delta["set"] == [] and delta["del"] == []
+    leader.put("/m/3", "updated")
+    delta = leader.snapshot_delta(follower.state_digest())
+    assert [row[0] for row in delta["set"]] == ["/m/3"]
+
+
+def test_digest_catches_same_revision_different_value(clock):
+    # a dirty ex-leader can hold the SAME revision number with a
+    # DIFFERENT value (its discarded uncommitted suffix) — the value
+    # crc in the digest must flag it even though revisions match
+    leader = InMemStore(clock=clock)
+    dirty = InMemStore(clock=clock)
+    rev = leader.put("/k", "committed")
+    dirty.apply_put("/k", "doomed", rev)
+    delta = leader.snapshot_delta(dirty.state_digest())
+    assert [row[:2] for row in delta["set"]] == [["/k", "committed"]]
+    dirty.install_snapshot_delta(delta)
+    assert dirty.get("/k").value == "committed"
+
+
+def test_install_snapshot_delta_resyncs_watchers(clock):
+    leader = InMemStore(clock=clock)
+    follower = InMemStore(clock=clock)
+    w = follower.watch("/d/")
+    for i in range(3):
+        leader.put(f"/d/{i}", str(i))
+    follower.install_snapshot_delta(
+        leader.snapshot_delta(follower.state_digest()))
+    batch = w.get(timeout=1.0)
+    # history before the snapshot revision is unknowable: the watcher
+    # gets the compacted resync, same contract as log compaction
+    assert batch is not None and batch.compacted
+    w.cancel()

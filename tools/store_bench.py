@@ -208,6 +208,259 @@ def bench_failover(writers_hz: float = 100.0) -> dict:
     }
 
 
+def bench_fleet(pods: int, streams: int, *, pods_per_host: int = 40,
+                prefixes: int = 128, tcp_streams: int = 200,
+                reg_writers: int = 8, burst: int = 8,
+                keepalive_window_s: float = 6.0,
+                keepalive_hosts: int = 25,
+                keepalive_per_pod: int = 400) -> dict:
+    """The 100k-pod / 1M-watch control-plane tier (ISSUE 18 acceptance):
+
+    - register ``pods`` simulated pods through coalesced HOST leases
+      (``pods_per_host`` registrations per lease) against a 3-replica
+      majority-ack group;
+    - attach ``streams`` in-proc watch streams THROUGH the watch relay
+      (one upstream stream per distinct prefix — ``prefixes`` of them),
+      plus a ``tcp_streams`` TCP cohort against a RelayServer to price
+      the socket path;
+    - mutation burst -> LEADER KILL -> second burst: every stream must
+      see every event of both bursts exactly once, revisions strictly
+      increasing (the relay's upstream watches resume by revision across
+      the failover — zero lost, zero duplicated);
+    - measure keepalive writes/s per pod with coalesced host leases vs
+      per-pod leases, live cohorts of each, in the same artifact.
+
+    CPU-host honesty: the 1M streams are in-proc ``RelayWatch`` handles
+    (``__slots__`` + shared batch refs make a million fit in RAM) and
+    the drain is a polling pass, not 1M blocked threads; the TCP cohort
+    is what prices real sockets. doc/design_coord.md carries the
+    limits table.
+    """
+    import threading as th
+
+    from edl_tpu.coord.client import (HostLeaseCoalescer, LeaseKeeper,
+                                      StoreClient)
+    from edl_tpu.coord.relay import RelayServer, WatchRelay
+    from edl_tpu.coord.replication import ReplicaGroup
+    from edl_tpu.utils.exceptions import EdlStoreError
+
+    def _put_retry(client, key, value, deadline_s: float = 30.0):
+        stop_at = time.monotonic() + deadline_s
+        while True:
+            try:
+                return client.put(key, value)
+            except EdlStoreError:
+                if time.monotonic() >= stop_at:
+                    raise
+                time.sleep(0.1)
+
+    out: dict = {"store_fleet_pods": pods,
+                 "store_fleet_pods_per_host": pods_per_host,
+                 "store_fleet_prefixes": prefixes}
+    with ReplicaGroup(3, election_ttl=0.8) as group:
+        group.wait_leader(timeout=20.0)
+        spec = ",".join(s.endpoint for s in group.servers)
+
+        # -- registration through coalesced host leases ------------------
+        hosts = (pods + pods_per_host - 1) // pods_per_host
+        host_ttl = 1800.0  # no keepalive traffic during the bench window
+        t0 = time.perf_counter()
+
+        def _register(wid: int) -> None:
+            c = group.client(timeout=5.0)
+            try:
+                for h in range(wid, hosts, reg_writers):
+                    lease = c.lease_grant(host_ttl)
+                    n = min(pods_per_host, pods - h * pods_per_host)
+                    for p in range(n):
+                        _put_retry(c, f"/fleet/pods/h{h:05d}/{p:02d}",
+                                   '{"host":%d,"slot":%d}' % (h, p))
+            finally:
+                c.close()
+
+        writers = [th.Thread(target=_register, args=(w,), daemon=True)
+                   for w in range(reg_writers)]
+        for t in writers:
+            t.start()
+        for t in writers:
+            t.join()
+        reg_s = time.perf_counter() - t0
+        out["store_fleet_hosts"] = hosts
+        out["store_fleet_reg_writes_per_sec"] = round(pods / reg_s, 1)
+
+        # -- the watch fleet: in-proc relay cohort + TCP relay cohort ----
+        relay = WatchRelay(spec, buffer=8192)
+        rs = RelayServer(spec, port=0, host="127.0.0.1").start()
+
+        def fan(k: int) -> str:
+            return f"/fleet/fan/{k:04d}/"
+
+        t0 = time.perf_counter()
+        watches = [relay.attach(fan(i % prefixes)) for i in range(streams)]
+        out["store_fleet_attach_s"] = round(time.perf_counter() - t0, 1)
+
+        tcp_clients = [StoreClient(f"127.0.0.1:{rs.port}", timeout=5.0)
+                       for _ in range(tcp_streams)]
+        tcp_watches = [c.watch(fan(i % prefixes), heartbeat=5.0,
+                               via_relay=False)
+                       for i, c in enumerate(tcp_clients)]
+
+        wc = group.client(timeout=5.0)
+
+        def _burst(base: int) -> None:
+            for i in range(prefixes * burst):
+                _put_retry(wc, fan(i % prefixes) + f"e{base + i:06d}",
+                           str(base + i))
+
+        def _wait_fanned(want: int, timeout: float) -> int:
+            stop_at = time.monotonic() + timeout
+            fanned = relay.stats()["relay_events_fanned_out"]
+            while fanned < want and time.monotonic() < stop_at:
+                time.sleep(0.05)
+                fanned = relay.stats()["relay_events_fanned_out"]
+            return fanned
+
+        per_stream = burst  # events each stream's prefix gets per burst
+        t0 = time.perf_counter()
+        _burst(0)
+        _wait_fanned(streams * per_stream, 120.0)
+        fan_a_s = time.perf_counter() - t0
+        out["store_fanout_events_per_sec"] = round(
+            streams * per_stream / fan_a_s, 1)
+
+        # -- leader kill mid-run: relay upstreams must resume ------------
+        t_kill = time.perf_counter()
+        group.kill_leader()
+        group.wait_leader(timeout=30.0)
+        probe_rev = _put_retry(wc, "/fleet/fan-probe", "alive")
+        out["store_fleet_failover_downtime_ms"] = round(
+            (time.perf_counter() - t_kill) * 1e3, 1)
+        del probe_rev
+        _burst(prefixes * burst)
+        fanned = _wait_fanned(2 * streams * per_stream, 180.0)
+        out["store_fleet_events_fanned"] = fanned
+
+        # -- exactly-once audit, per stream ------------------------------
+        expected = 2 * per_stream
+        delivered = lost = dups = compacted_streams = 0
+        for w in watches:
+            last = 0
+            n = 0
+            comp = False
+            while True:
+                b = w.get(timeout=0)
+                if b is None:
+                    break
+                if b.compacted:
+                    comp = True
+                for ev in b.events:
+                    if ev.revision <= last:
+                        dups += 1
+                    last = ev.revision
+                    n += 1
+            delivered += n
+            if comp:
+                compacted_streams += 1
+            elif n < expected:
+                lost += expected - n
+
+        tcp_delivered = tcp_dups = 0
+        for w in tcp_watches:
+            got = 0
+            last = 0
+            stop_at = time.monotonic() + 60.0
+            while got < expected and time.monotonic() < stop_at:
+                b = w.get(timeout=0.5)
+                if b is None:
+                    continue
+                for ev in b.events:
+                    if ev.key == "/fleet/fan-probe":
+                        continue
+                    if ev.revision <= last:
+                        tcp_dups += 1
+                    last = ev.revision
+                    got += 1
+            tcp_delivered += got
+
+        relay_stats = relay.stats()
+        out["store_watch_streams"] = streams + tcp_streams
+        out["store_fleet_events_expected"] = streams * expected
+        out["store_fleet_events_delivered"] = delivered
+        out["store_fleet_events_lost"] = lost
+        out["store_fleet_duplicates"] = dups + tcp_dups
+        out["store_fleet_compacted_streams"] = compacted_streams
+        out["store_fleet_tcp_streams"] = tcp_streams
+        out["store_fleet_tcp_delivered_pct"] = round(
+            100.0 * tcp_delivered / max(tcp_streams * expected, 1), 2)
+        out["store_fleet_upstream_streams"] = \
+            relay_stats["relay_upstream_streams"]
+        out["store_fleet_upstream_resumes"] = relay_stats["relay_resumes"]
+
+        for w in tcp_watches:
+            w.cancel()
+        for c in tcp_clients:
+            c.close()
+        rs.stop()
+        relay.close()  # cancels every in-proc RelayWatch in one sweep
+
+        # -- keepalive writes/s: coalesced host leases vs per-pod --------
+        ka_client = group.client(timeout=5.0)
+        coalescers = [HostLeaseCoalescer(ka_client, f"bench-host-{h}",
+                                         ttl=3.0)
+                      for h in range(keepalive_hosts)]
+        for h, co in enumerate(coalescers):
+            for p in range(pods_per_host):
+                co.attach(f"/fleet/ka/h{h:03d}/{p:02d}")
+        time.sleep(keepalive_window_s)
+        coalesced_writes = sum(co.stats()["keepalives_sent"]
+                               for co in coalescers)
+        for co in coalescers:
+            co.close(revoke=True)
+        co_pods = keepalive_hosts * pods_per_host
+        coalesced_per_pod = coalesced_writes / keepalive_window_s / co_pods
+
+        class _CountingLeases:
+            """Store facade counting keepalive writes (LeaseKeeper only
+            touches lease_keepalive/lease_revoke)."""
+
+            def __init__(self, inner):
+                self.inner = inner
+                self.count = 0
+                self._lock = th.Lock()
+
+            def lease_keepalive(self, lease: int) -> bool:
+                with self._lock:
+                    self.count += 1
+                return self.inner.lease_keepalive(lease)
+
+            def lease_revoke(self, lease: int) -> None:
+                self.inner.lease_revoke(lease)
+
+        counting = _CountingLeases(ka_client)
+        keepers = []
+        for _ in range(keepalive_per_pod):
+            lease = ka_client.lease_grant(3.0)
+            keepers.append(LeaseKeeper(counting, lease,
+                                       interval=0.5).start())
+        time.sleep(keepalive_window_s)
+        per_pod_writes = counting.count
+        for k in keepers:
+            k.stop(revoke=True)
+        per_pod_rate = (per_pod_writes / keepalive_window_s
+                        / keepalive_per_pod)
+
+        out["store_fleet_keepalive_writes_per_sec_per_pod"] = round(
+            coalesced_per_pod, 4)
+        out["store_fleet_keepalive_writes_per_sec_per_pod_uncoalesced"] \
+            = round(per_pod_rate, 4)
+        out["store_fleet_keepalive_reduction_x"] = round(
+            per_pod_rate / max(coalesced_per_pod, 1e-9), 1)
+
+        wc.close()
+        ka_client.close()
+    return out
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="replicated-store control-plane load bench")
@@ -217,19 +470,55 @@ def main(argv=None) -> int:
                         help="in-proc watch streams on one follower")
     parser.add_argument("--tcp-streams", type=int, default=50,
                         help="TCP watch streams on one follower")
+    parser.add_argument("--fleet", action="store_true",
+                        help="run the relay-tier fleet bench instead: "
+                             "coalesced-lease registrations, watch "
+                             "streams through the relay, leader kill "
+                             "with per-stream exactly-once audit, "
+                             "keepalive coalescing ratio")
+    parser.add_argument("--fleet-pods", type=int, default=100_000,
+                        help="fleet mode: simulated pod registrations")
+    parser.add_argument("--fleet-streams", type=int, default=1_000_000,
+                        help="fleet mode: in-proc relay watch streams")
+    parser.add_argument("--fleet-prefixes", type=int, default=128,
+                        help="fleet mode: distinct watched prefixes")
+    parser.add_argument("--fleet-tcp-streams", type=int, default=200,
+                        help="fleet mode: TCP streams via RelayServer")
+    parser.add_argument("--pods-per-host", type=int, default=40,
+                        help="fleet mode: registrations per host lease")
     parser.add_argument("--json", default=None,
                         help="write the artifact JSON here")
     args = parser.parse_args(argv)
 
     out: dict = {"host_cores": os.cpu_count()}
-    out.update(bench_registrations(args.pods))
-    out.update(bench_watch_fanout(args.streams, args.tcp_streams))
-    out.update(bench_failover())
+    if args.fleet:
+        out.update(bench_fleet(args.fleet_pods, args.fleet_streams,
+                               pods_per_host=args.pods_per_host,
+                               prefixes=args.fleet_prefixes,
+                               tcp_streams=args.fleet_tcp_streams))
+    else:
+        out.update(bench_registrations(args.pods))
+        out.update(bench_watch_fanout(args.streams, args.tcp_streams))
+        out.update(bench_failover())
 
     print(json.dumps(out, indent=2))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2)
+    if args.fleet:
+        bad = []
+        if out["store_fleet_events_lost"] != 0:
+            bad.append(f"{out['store_fleet_events_lost']} events lost "
+                       "across the leader kill")
+        if out["store_fleet_duplicates"] != 0:
+            bad.append(f"{out['store_fleet_duplicates']} duplicate "
+                       "deliveries")
+        if out["store_fleet_keepalive_reduction_x"] < 10.0:
+            bad.append("keepalive coalescing under the 10x floor "
+                       f"({out['store_fleet_keepalive_reduction_x']}x)")
+        for b in bad:
+            print(f"FAIL: {b}", file=sys.stderr)
+        return 1 if bad else 0
     if out["store_events_lost"] != 0:
         print("FAIL: events lost across failover", file=sys.stderr)
         return 1
