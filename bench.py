@@ -977,6 +977,215 @@ def bench_dcn_comm(on_tpu: bool) -> dict:
     return out
 
 
+def bench_moe(on_tpu: bool) -> dict:
+    """Expert-parallel dispatch behind its parity gate.
+
+    MoE twin of bench_dcn_comm: a top-2 capacity-factor router over
+    E = 2 x world expert FFNs, trained through the hierarchical
+    all-to-all (ICI leg + cross-slice DCN leg, doc/design_comm.md).
+    Throughput and byte numbers report ONLY after comm.moe_parity_gate
+    passes: hier/off must be BITWISE with the flat single-collective
+    dispatch through real optimizer steps, and the int8 DCN leg must
+    hold the loss envelope. A failed gate nulls the wire metrics.
+
+    The resize row times an ep world change UNDER LOAD: the trained
+    expert tables are saved as ep-sharded checkpoint leaves, resharded
+    onto the half world through the same planner the migration plane
+    rides (train/sharded_checkpoint.py — the in-process analogue of
+    bench_resize_reform's multi-pod ladder), grafted back into a live
+    step, and the first post-resize step is clocked; the restored
+    tables are asserted bitwise against the donors.
+
+    CPU-harness caveats match bench_dcn_comm: step times are schedule
+    costs (every byte rides host links), `moe_dispatch_overlap_pct`
+    is the SCHEDULE property (legs dispatchable before the final
+    combine), bytes columns are exact wire accounting either way.
+    """
+    import dataclasses
+    import functools
+    import shutil
+    import sys
+    import tempfile
+
+    from flax.core import meta
+
+    from edl_tpu.models.transformer import (Transformer,
+                                            TransformerConfig,
+                                            lm_loss_moe)
+    from edl_tpu.parallel import mesh as mesh_lib
+    from edl_tpu.train import comm
+    from edl_tpu.train import sharded_checkpoint as sc
+    from edl_tpu.train.state import TrainState
+    from edl_tpu.train.step import make_train_step
+
+    NULL_KEYS = ("moe_tokens_per_sec", "moe_dcn_bytes_per_step",
+                 "moe_dcn_bytes_per_step_int8",
+                 "moe_dcn_bytes_reduction_int8_x",
+                 "moe_dispatch_overlap_pct",
+                 "moe_ep_resize_s", "moe_ep_resize_bitwise")
+    n_dev = len(jax.devices())
+    if n_dev < 2 or n_dev % 2:
+        return {"moe_gate_ok": None, **{k: None for k in NULL_KEYS}}
+    if on_tpu:
+        dim, layers, vocab, seq, B, steps = 256, 2, 4096, 128, 8, 8
+        bucket_mb = 4.0
+    else:
+        # bucket at 0.25 MiB (not bench_dcn_comm's 0.05): the system
+        # under test is the DISPATCH wire; sub-bucket-sized gradient
+        # shards compile to different reduce schedules across the
+        # flat/hier programs on CPU XLA and break the bitwise gate
+        dim, layers, vocab, seq, B, steps = 64, 2, 128, 32, 4, 4
+        bucket_mb = 0.25
+    cfg = TransformerConfig(vocab_size=vocab, d_model=dim, n_heads=4,
+                            n_layers=layers, d_ff=dim * 4, max_len=seq,
+                            dtype=jnp.bfloat16 if on_tpu
+                            else jnp.float32, mesh=None, moe=True,
+                            n_experts=2 * n_dev, moe_top_k=2)
+    model = Transformer(cfg)
+    rng = np.random.default_rng(7)
+    toks = rng.integers(0, vocab, size=(B * n_dev, seq)).astype(np.int32)
+    variables = meta.unbox(model.init(jax.random.PRNGKey(7),
+                                      jnp.asarray(toks), train=False))
+    import optax as _optax
+    state = TrainState.create(apply_fn=model.apply,
+                              params=variables["params"],
+                              tx=_optax.sgd(0.1, momentum=0.9))
+    batch = {"tokens": toks}
+
+    def loss_factory(wire):
+        wired = Transformer(dataclasses.replace(cfg, moe_wire=wire))
+        return functools.partial(lm_loss_moe,
+                                 aux_weight=cfg.moe_aux_weight,
+                                 apply_fn=wired.apply)
+
+    topo = mesh_lib.SliceTopology(2, n_dev // 2)
+    mesh = mesh_lib.make_hybrid_mesh(mesh_lib.MeshSpec({"ep": -1}),
+                                     topo)
+    comm_cfg = comm.CommConfig(bucket_mb=bucket_mb)
+    # gate first: hier/off bitwise with flat + int8 leg inside the
+    # envelope, through real steps on the deployment topology
+    gate = comm.moe_parity_gate(
+        loss_factory, state, batch, mesh=mesh, topology=topo,
+        comm_config=comm_cfg,
+        moe_config=comm.MoEDispatchConfig(mode="hier", compress="int8"),
+        steps=3, envelope=0.1)
+    gate_ok = bool(gate["ok"])
+
+    def timed(step_fn, mesh_, batch_):
+        s = jax.tree.map(lambda a: jax.device_put(
+            a, jax.sharding.NamedSharding(
+                mesh_, jax.sharding.PartitionSpec())), state)
+        placed = mesh_lib.shard_batch(mesh_, batch_,
+                                      batch_axes=("ep",))
+        for _ in range(2):
+            s, m = step_fn(s, placed)
+        _sync(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            s, m = step_fn(s, placed)
+        _sync(m["loss"])
+        return (time.perf_counter() - t0) / steps * 1e3, s
+
+    # jit-dense reference: routes per GLOBAL batch (different capacity
+    # semantics than the per-chip manual path) — timing anchor only
+    jit_loss = functools.partial(lm_loss_moe,
+                                 aux_weight=cfg.moe_aux_weight)
+    jit_ms, _ = timed(make_train_step(jit_loss, donate=False), mesh,
+                      batch)
+    mk = lambda mode, compress: comm.make_moe_comm_step(  # noqa: E731
+        loss_factory, mesh=mesh, topology=topo, donate=False,
+        config=comm_cfg,
+        moe_config=comm.MoEDispatchConfig(mode=mode, compress=compress))
+    flat_step = mk("flat", "off")
+    flat_ms, _ = timed(flat_step, mesh, batch)
+    hier_step = mk("hier", "off")
+    hier_ms, _ = timed(hier_step, mesh, batch)
+    int8_step = mk("hier", "int8")
+    int8_ms, s_final = timed(int8_step, mesh, batch)
+
+    out = {
+        "moe_gate_ok": gate_ok,
+        "moe_parity_bitwise_hier": bool(gate["bitwise_hier"]),
+        "moe_loss_envelope_ok": bool(gate.get("loss_envelope_ok")),
+        "moe_experts": cfg.n_experts,
+        "moe_jit_step_ms": round(jit_ms, 2),
+        "moe_flat_step_ms": round(flat_ms, 2),
+        "moe_hier_step_ms": round(hier_ms, 2),
+        "moe_int8_step_ms": round(int8_ms, 2),
+    }
+    if not gate_ok:
+        out.update({k: None for k in NULL_KEYS})
+        return out
+
+    flat_bytes = flat_step.moe_dcn_bytes_per_step()
+    int8_bytes = int8_step.moe_dcn_bytes_per_step()
+    out.update({
+        # deployment path (hier + int8 DCN leg) end-to-end token rate
+        "moe_tokens_per_sec": round(B * n_dev * seq / (int8_ms / 1e3),
+                                    1),
+        "moe_dcn_bytes_per_step": flat_bytes,
+        "moe_dcn_bytes_per_step_int8": int8_bytes,
+        "moe_dcn_bytes_reduction_int8_x": round(
+            flat_bytes / max(int8_bytes, 1), 2),
+        "moe_dispatch_overlap_pct": int8_step.moe_dispatch_overlap_pct(),
+        "moe_ep_resize_s": None,
+        "moe_ep_resize_bitwise": None,
+    })
+
+    # -- ep resize under load: full world -> half world ----------------
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+    half = n_dev // 2
+    tgt_mesh = Mesh(np.array(jax.devices()[:half]), ("ep",))
+
+    def _path_key(path) -> str:
+        return "/".join(str(getattr(p, "key", p)) for p in path)
+
+    flat_params, treedef = jax.tree_util.tree_flatten_with_path(
+        s_final.params)
+    tables = {_path_key(p): leaf for p, leaf in flat_params
+              if "moe_mlp" in _path_key(p)
+              and _path_key(p).rsplit("/", 1)[-1] in ("w_in", "w_out")}
+    # checkpoint representation: expert tables are ep-sharded leaves
+    src = {k: jax.device_put(v, NamedSharding(mesh, P("ep")))
+           for k, v in tables.items()}
+    half_step = comm.make_moe_comm_step(
+        loss_factory, mesh=tgt_mesh, topology=None, donate=False,
+        config=comm_cfg,
+        moe_config=comm.MoEDispatchConfig(mode="hier", compress="int8"))
+    tmp = tempfile.mkdtemp(prefix="bench_moe_resize_")
+    try:
+        t0 = time.perf_counter()
+        sc.save_sharded(tmp, src)
+        tgt = {k: jax.device_put(np.zeros(v.shape, v.dtype),
+                                 NamedSharding(tgt_mesh, P("ep")))
+               for k, v in tables.items()}
+        restored = sc.restore_sharded(tmp, tgt)
+        host = {k: np.asarray(v) for k, v in restored.items()}
+        # graft the resharded tables into the surviving step's state
+        grafted = jax.tree_util.tree_unflatten(
+            treedef, [host.get(_path_key(p), leaf)
+                      for p, leaf in flat_params])
+        s2 = jax.tree.map(
+            lambda a: jax.device_put(np.asarray(a),
+                                     NamedSharding(tgt_mesh, P())),
+            s_final.replace(params=grafted))
+        placed = mesh_lib.shard_batch(tgt_mesh,
+                                      {"tokens": toks[:B * half]},
+                                      batch_axes=("ep",))
+        s2, m = half_step(s2, placed)
+        _sync(m["loss"])
+        out["moe_ep_resize_s"] = round(time.perf_counter() - t0, 3)
+        out["moe_ep_resize_bitwise"] = bool(all(
+            np.array_equal(host[k], np.asarray(v))
+            for k, v in tables.items()))
+    except (OSError, ValueError, TypeError) as exc:
+        print(f"moe resize bench failed: {exc}", file=sys.stderr)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def bench_distill_churn(on_tpu: bool) -> dict:
     """Distill throughput UNDER teacher churn (VERDICT r5 ask #6).
 
@@ -2129,6 +2338,7 @@ def main() -> None:
     flash = bench_flash_kernel(on_tpu)
     hybrid = bench_hybrid_mesh(on_tpu)
     dcn = bench_dcn_comm(on_tpu)
+    moe = bench_moe(on_tpu)
     distill = bench_distill(on_tpu)
     churn = bench_distill_churn(on_tpu)
     ckpt = bench_checkpoint(on_tpu)
@@ -2242,6 +2452,11 @@ def main() -> None:
             # envelope: per-chip cross-slice bytes/step and the
             # schedulable comm/compute overlap of the bucketed plan
             **dcn,
+            # expert-parallel dispatch (hierarchical all-to-all + int8
+            # DCN leg) behind comm.moe_parity_gate, plus the ep
+            # resize-under-load gap through the sharded-checkpoint
+            # planner (tools/comm_bench.py --moe has the mode sweep)
+            **moe,
             # distill wire numbers are MEDIAN OF 3 with [min, max]
             "distill_student_imgs_per_sec": distill["imgs_per_sec"],
             "distill_student_imgs_per_sec_spread":

@@ -16,6 +16,8 @@ sharded checkpoints (per-process chunks + resharding restore), tokens/s
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import functools
 import os
 import sys
 import time
@@ -27,7 +29,8 @@ import optax
 
 from edl_tpu.data.pipeline import DataLoader, FileSource
 from edl_tpu.models.transformer import (Transformer, TransformerConfig,
-                                        lm_loss_fn, lm_loss_fused)
+                                        lm_loss_fn, lm_loss_fused,
+                                        lm_loss_moe)
 from edl_tpu.parallel import distributed, mesh as mesh_lib, sharding as shd
 from edl_tpu.train import lr as lr_lib
 from edl_tpu.train.benchlog import BenchmarkLog
@@ -107,6 +110,29 @@ def main(argv=None) -> int:
                              "overlap earlier buckets' communication "
                              "(default $EDL_TPU_COMM_BUCKET_MB, else 0 "
                              "= XLA's single fused reduction)")
+    parser.add_argument("--moe", action="store_true",
+                        help="mixture-of-experts FFNs: top-k capacity-"
+                             "factor router, expert tables sharded over "
+                             "an ep mesh, hierarchical all-to-all "
+                             "dispatch (train/comm.py; "
+                             "doc/design_comm.md)")
+    parser.add_argument("--n-experts", type=int, default=0,
+                        help="expert count (default 2x device count; "
+                             "must divide evenly over the devices)")
+    parser.add_argument("--moe-top-k", type=int, default=2,
+                        help="experts per token")
+    parser.add_argument("--moe-dispatch", choices=("flat", "hier"),
+                        default=None,
+                        help="MoE all-to-all decomposition (default "
+                             "$EDL_TPU_MOE_DISPATCH, else hier): flat = "
+                             "one global collective; hier = ICI leg + "
+                             "cross-slice DCN leg, bitwise with flat")
+    parser.add_argument("--moe-compress", choices=("off", "int8"),
+                        default=None,
+                        help="MoE DCN-leg wire format (default "
+                             "$EDL_TPU_MOE_COMPRESS, else off): int8 "
+                             "ships dispatched activations at one scale "
+                             "per destination slice (parity-gated)")
     parser.add_argument("--fused-opt",
                         choices=("off", "fp32", "int8", "fp8"),
                         default=None,
@@ -207,11 +233,27 @@ def main(argv=None) -> int:
             raise SystemExit(f"--mesh sp shards the sequence over "
                              f"{n_dev} devices; --seq-len {args.seq_len} "
                              f"is not divisible by {n_dev}")
+    if args.moe:
+        if kind != "dp":
+            raise SystemExit(f"--moe owns the ep mesh (expert tables "
+                             f"sharded over every chip); --mesh {kind} "
+                             "conflicts")
+        if args.fp16:
+            raise SystemExit("--moe is not supported with --fp16 (the "
+                             "MoE comm step owns the backward; no "
+                             "loss-scale hook)")
+        if args.fused_loss:
+            raise SystemExit("--fused-loss has no MoE variant (the MoE "
+                             "loss collects router aux terms)")
+        if args.batch_size % jax.device_count():
+            raise SystemExit(f"--moe routes per chip: --batch-size "
+                             f"{args.batch_size} must divide over "
+                             f"{jax.device_count()} devices")
     # env-aware: multi-slice jobs get the hybrid ICI x DCN layout (needs
-    # a dp axis to carry DCN — other --mesh kinds fail fast there);
-    # single-slice worlds get the flat mesh as before
-    mesh = distributed.make_mesh_from_env(mesh_lib.MeshSpec({kind: -1}),
-                                          env)
+    # a dp axis — or ep under --moe — to carry DCN; other --mesh kinds
+    # fail fast there); single-slice worlds get the flat mesh as before
+    mesh = distributed.make_mesh_from_env(
+        mesh_lib.MeshSpec({"ep" if args.moe else kind: -1}), env)
     # DCN-aware gradient path: CLI > env (LoopConfig binding) > off.
     # A compressed wire implies bucketing (default 4 MiB target).
     dcn_compress = (args.dcn_compress if args.dcn_compress is not None
@@ -233,6 +275,16 @@ def main(argv=None) -> int:
         from edl_tpu.train.comm import CommConfig
         comm_cfg = CommConfig(bucket_mb=comm_bucket_mb or 4.0,
                               compress=dcn_compress)
+    # MoE dispatch knobs: CLI > env (LoopConfig binding) > hier/off.
+    moe_dispatch = (args.moe_dispatch if args.moe_dispatch is not None
+                    else loop_cfg.moe_dispatch)
+    moe_compress = (args.moe_compress if args.moe_compress is not None
+                    else loop_cfg.moe_compress)
+    if args.moe and dcn_compress != "off":
+        raise SystemExit("--dcn-compress compresses the dp gradient "
+                         "wire; under --moe the wire knob is "
+                         "--moe-compress (gradient compression over "
+                         "the ep axis is not parity-gated yet)")
     # Fused optimizer path: CLI > env (LoopConfig binding) > off;
     # EDL_TPU_OPT_QUANT overrides just the resident-moment codec.
     fused_opt = (args.fused_opt if args.fused_opt is not None
@@ -253,18 +305,22 @@ def main(argv=None) -> int:
             "quantized moments would still carry the overflowed "
             "requantization residuals. Use --fused-opt fp32 (bitwise, "
             "rollback-safe) or bf16/fp32 activations.")
+    moe_kw = {}
+    if args.moe:
+        moe_kw = dict(moe=True,
+                      n_experts=args.n_experts or 2 * jax.device_count(),
+                      moe_top_k=args.moe_top_k)
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_len=args.seq_len,
         dtype=(jnp.float16 if args.fp16
                else jnp.bfloat16 if args.bf16 else jnp.float32),
-        # the comm step's manual region is mesh-free: sharding
+        # the comm/moe step's manual region is mesh-free: sharding
         # constraints / nested shard_maps would clash with the manual
-        # dp axis — each shard computes exactly one chip's backward
-        mesh=None if comm_cfg is not None else mesh)
+        # dp/ep axis — each shard computes exactly one chip's backward
+        mesh=None if (comm_cfg is not None or args.moe) else mesh,
+        **moe_kw)
     if args.remat != "off":
-        import dataclasses
-
         from edl_tpu.models.transformer import auto_remat
         cfg = (auto_remat(cfg, local_bs)
                if args.remat == "auto"
@@ -310,6 +366,25 @@ def main(argv=None) -> int:
         def step(state, batch):
             state, metrics, ls_box[0] = raw_step(state, batch, ls_box[0])
             return state, metrics
+    elif args.moe:
+        from edl_tpu.train.comm import (MoEDispatchConfig,
+                                        make_moe_comm_step)
+
+        def moe_loss_factory(wire):
+            wired = Transformer(dataclasses.replace(cfg, moe_wire=wire))
+            return functools.partial(lm_loss_moe,
+                                     aux_weight=cfg.moe_aux_weight,
+                                     apply_fn=wired.apply)
+
+        step = make_moe_comm_step(
+            moe_loss_factory, mesh=mesh,
+            topology=distributed.slice_topology(env),
+            config=comm_cfg, donate=True,
+            moe_config=MoEDispatchConfig(mode=moe_dispatch,
+                                         compress=moe_compress))
+        log.info("moe path: E=%d top_k=%d dispatch=%s compress=%s",
+                 cfg.n_experts, cfg.moe_top_k, moe_dispatch,
+                 moe_compress)
     elif comm_cfg is not None:
         step = make_train_step(loss, donate=True, comm=comm_cfg,
                                mesh=mesh,
@@ -331,7 +406,11 @@ def main(argv=None) -> int:
 
     # eval must honor the fused path too — the dense loss would
     # materialize exactly the logits tensor --fused-loss exists to avoid
-    eval_loss_fn = lm_loss_fused if args.fused_loss else lm_loss_fn
+    # (MoE eval rides the jit-dense router: global-batch capacity)
+    eval_loss_fn = (functools.partial(lm_loss_moe,
+                                      aux_weight=cfg.moe_aux_weight)
+                    if args.moe
+                    else lm_loss_fused if args.fused_loss else lm_loss_fn)
     eval_step = jax.jit(lambda s, b: eval_loss_fn(s, s.params, b)[0])
     blog = BenchmarkLog(f"transformer_lm_{args.d_model}d{args.n_layers}L",
                         batch_size=args.batch_size, world_size=world)
@@ -356,7 +435,8 @@ def main(argv=None) -> int:
 
     loop = TrainLoop(
         step, state, mesh=mesh, config=loop_cfg, eval_fn=eval_fn,
-        place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t))
+        place_state=lambda t: mesh_lib.replicate_host_tree(mesh, t),
+        batch_axes=("ep",) if args.moe else None)
 
     def data_fn(epoch):
         return ({"tokens": b["tokens"]} for b in loader.epoch(epoch))
@@ -364,7 +444,7 @@ def main(argv=None) -> int:
     data_fn.close = loader.close  # TrainLoop tears down the mp workers
     status = loop.run(data_fn)
     blog.extra(**loop.ckpt_stats())  # save-stall / restore accounting
-    if comm_cfg is not None:
+    if comm_cfg is not None or args.moe:
         blog.extra(**step.stats())  # bucket plan + DCN wire accounting
     if rank == 0 and args.benchmark_log:
         blog.write(args.benchmark_log, rank)

@@ -45,6 +45,38 @@ class TransformerConfig:
     # activations get logical sharding constraints. None = single-device.
     mesh: Mesh | None = dfield(default=None, hash=False, compare=False)
     rules: tuple = shd.DEFAULT_RULES
+    # -- mixture of experts (dense fallback: moe=False leaves every
+    # existing config byte-identical — blocks keep the plain MLP).
+    # moe=True swaps each block's MLP for MoEMLP: a top-k
+    # capacity-factor router over n_experts expert FFNs whose tables
+    # carry the ("expert", ...) logical axis — sharded over ep by
+    # sharding.DEFAULT_RULES, so they enter the checkpoint index as
+    # ep-sharded leaves and re-shard on resize like any sharded state.
+    moe: bool = False
+    n_experts: int = 8
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    # moe_wire: transport for expert dispatch/combine. None = dense
+    # einsum dispatch (single device, or XLA-partitioned over an ep
+    # mesh). Inside a manual shard_map region, train/comm injects its
+    # hierarchical all-to-all wire here (an object with
+    # dispatch/combine/local_slice — see comm.MoEWire).
+    moe_wire: Any = dfield(default=None, hash=False, compare=False)
+
+    def __post_init__(self):
+        if self.moe:
+            if self.n_experts < 2:
+                raise ValueError(
+                    f"moe needs n_experts >= 2, got {self.n_experts}")
+            if not 1 <= self.moe_top_k <= self.n_experts:
+                raise ValueError(
+                    f"moe_top_k must be in [1, n_experts="
+                    f"{self.n_experts}], got {self.moe_top_k}")
+            if self.moe_capacity_factor <= 0:
+                raise ValueError(
+                    f"moe_capacity_factor must be > 0, got "
+                    f"{self.moe_capacity_factor}")
 
     @property
     def head_dim(self) -> int:
@@ -143,6 +175,133 @@ class Attention(nn.Module):
         return cfg.constrain(o, ("batch", "seq", "embed"))
 
 
+def moe_capacity(n_tokens: int, n_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    """Per-expert token buffer size: ceil(cf * T * k / E), at least 1.
+
+    Static (T is a trace-time constant), so every dispatch buffer —
+    and therefore the all-to-all wire — has a fixed shape regardless
+    of where the router actually sends tokens."""
+    import math
+    return max(1, math.ceil(capacity_factor * n_tokens * top_k
+                            / n_experts))
+
+
+def router_topk(logits: jax.Array, top_k: int, capacity: int
+                ) -> tuple[jax.Array, jax.Array, dict]:
+    """Top-k capacity-factor routing (Switch/GShard style), pure dense
+    math so it jits on any backend and tests can hit the capacity
+    edges without flax.
+
+    logits: (T, E) router scores. Each token picks its top_k experts by
+    softmax probability; within each expert, slots are granted in
+    CHOICE-MAJOR order (every token's first choice is placed before any
+    second choice), and assignments past ``capacity`` are dropped —
+    the token's output falls through the residual connection, the
+    standard capacity-factor contract.
+
+    Returns ``(combine, dispatch, aux)``: combine (T, E, C) fp32 gate
+    weights (renormalized over the kept top-k), dispatch (T, E, C)
+    bool one-hot slot assignment, and aux = {load_balance (the Shazeer
+    f·p loss, 1.0 at perfect balance), dropped_frac (fraction of the
+    T*k assignments dropped by capacity — the accounting the tests
+    pin)}.
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate, idx = jax.lax.top_k(probs, top_k)                 # (T, k)
+    gate = gate / jnp.maximum(jnp.sum(gate, -1, keepdims=True), 1e-9)
+    oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)          # (T, k, E)
+    # position of each assignment inside its expert's buffer,
+    # choice-major: flatten to (k*T, E) with choice as the slow dim
+    flat = oh.transpose(1, 0, 2).reshape(top_k * t, e)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat
+    pos = jnp.sum(pos_flat * flat, axis=-1).reshape(top_k, t).T
+    pos = pos.astype(jnp.int32)                             # (T, k)
+    kept = pos < capacity
+    # one_hot of `capacity` (out of range) is the all-zero row, so a
+    # dropped assignment vanishes from dispatch AND combine
+    pos_oh = jax.nn.one_hot(jnp.where(kept, pos, capacity), capacity,
+                            dtype=jnp.float32)              # (T, k, C)
+    dispatch = jnp.einsum("tke,tkc->tec", oh, pos_oh) > 0
+    combine = jnp.einsum("tk,tke,tkc->tec", gate, oh, pos_oh)
+    f = jnp.mean(jnp.sum(oh, axis=1), axis=0) / top_k       # (E,)
+    p = jnp.mean(probs, axis=0)
+    aux = {"load_balance": e * jnp.sum(f * p),
+           "dropped_frac": 1.0 - jnp.mean(kept.astype(jnp.float32))}
+    return combine, dispatch, aux
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel MLP: top-k capacity-factor router + n_experts
+    gelu FFNs whose (E, ...) tables carry the "expert" logical axis
+    (sharded over ep by sharding.DEFAULT_RULES — the leaves the
+    checkpoint index stores ep-sharded and re-shards on resize).
+
+    Two transports, one set of router/expert math:
+    - cfg.moe_wire=None (default): dense einsum dispatch. On a single
+      device this is the whole layer; on an ep mesh XLA's partitioner
+      turns the (E, cap, d) einsums into its own all-to-all.
+    - cfg.moe_wire set (inside train/comm's manual shard_map region):
+      the wire object transports the per-chip dispatch buffer to the
+      experts' owner chips (hierarchical ICI/DCN all-to-all, optionally
+      int8 on the DCN leg) and back; each chip computes only its
+      local expert slice.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, s, d = x.shape
+        e, k = cfg.n_experts, cfg.moe_top_k
+        t = b * s
+        cap = moe_capacity(t, e, k, cfg.moe_capacity_factor)
+        router = self.param(
+            "router",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         ("embed", "expert_router")),
+            (cfg.d_model, e))
+        table_init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "normal", in_axis=-2, out_axis=-1,
+            batch_axis=(0,))
+        w_in = self.param(
+            "w_in", nn.with_logical_partitioning(
+                table_init, ("expert", "embed", "mlp")),
+            (e, cfg.d_model, cfg.d_ff))
+        w_out = self.param(
+            "w_out", nn.with_logical_partitioning(
+                table_init, ("expert", "mlp", "embed")),
+            (e, cfg.d_ff, cfg.d_model))
+
+        xf = x.reshape(t, d)
+        logits = jnp.einsum("td,de->te", xf.astype(jnp.float32),
+                            router.astype(jnp.float32))
+        combine, dispatch, aux = router_topk(logits, k, cap)
+        self.sow("intermediates", "moe_aux", aux["load_balance"])
+        self.sow("intermediates", "moe_dropped", aux["dropped_frac"])
+
+        buf = jnp.einsum("tec,td->ecd", dispatch.astype(cfg.dtype), xf)
+        wire = cfg.moe_wire
+        if wire is None:
+            h = jnp.einsum("ecd,edf->ecf", buf,
+                           w_in.astype(cfg.dtype))
+            h = nn.gelu(h)
+            out = jnp.einsum("ecf,efd->ecd", h,
+                             w_out.astype(cfg.dtype))
+        else:
+            recv = wire.dispatch(buf)           # (E/W, W*cap, d)
+            h = jnp.einsum("ecd,edf->ecf", recv,
+                           wire.local_slice(w_in).astype(cfg.dtype))
+            h = nn.gelu(h)
+            out = jnp.einsum("ecf,efd->ecd", h,
+                             wire.local_slice(w_out).astype(cfg.dtype))
+            out = wire.combine(out)             # back to (E, cap, d)
+        y = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype), out)
+        return y.reshape(b, s, d)
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
 
@@ -155,10 +314,14 @@ class Block(nn.Module):
             h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         x = x + h
         h = nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x)
-        h = _dense(cfg.d_ff, ("embed", "mlp"), cfg, name="mlp_in")(h)
-        h = nn.gelu(h)
-        h = cfg.constrain(h, ("batch", "seq", "mlp"))
-        h = _dense(cfg.d_model, ("mlp", "embed"), cfg, name="mlp_out")(h)
+        if cfg.moe:
+            h = MoEMLP(cfg, name="moe_mlp")(h)
+        else:
+            h = _dense(cfg.d_ff, ("embed", "mlp"), cfg, name="mlp_in")(h)
+            h = nn.gelu(h)
+            h = cfg.constrain(h, ("batch", "seq", "mlp"))
+            h = _dense(cfg.d_model, ("mlp", "embed"), cfg,
+                       name="mlp_out")(h)
         if cfg.dropout > 0:
             h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
         return x + h
@@ -245,6 +408,44 @@ def lm_loss_fused(state, params, batch, *, chunk: int = 8192):
     kernel = params["lm_head"]["kernel"]
     loss = streamed_lm_xent(hidden, kernel, targets, chunk)
     return loss, {"ppl": jnp.exp(loss)}
+
+
+def _sown(intermediates, name: str) -> list:
+    """Collect every `self.sow`-ed value called ``name`` in a
+    variables['intermediates'] tree (one per MoE block)."""
+    from jax.tree_util import tree_flatten_with_path
+
+    leaves, _ = tree_flatten_with_path(intermediates)
+    return [v for path, v in leaves
+            if any(getattr(kk, "key", None) == name for kk in path)]
+
+
+def lm_loss_moe(state, params, batch, *, aux_weight: float = 0.01,
+                apply_fn=None):
+    """lm_loss_fn for moe=True configs: next-token CE plus the routers'
+    load-balance auxiliary (aux_weight * mean over MoE blocks), with
+    the capacity-drop fraction reported in the metrics. ``apply_fn``
+    overrides state.apply_fn when the loss must run a DIFFERENT model
+    binding than the state was built with (the manual-dispatch path
+    rebinds cfg.moe_wire without touching the params)."""
+    fn = apply_fn or state.apply_fn
+    logits, mutated = fn({"params": params}, batch["tokens"],
+                         train=True, mutable=["intermediates"])
+    targets = batch["tokens"][:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    ce = -jnp.mean(ll)
+    inter = mutated.get("intermediates", {})
+    aux = _sown(inter, "moe_aux")
+    dropped = _sown(inter, "moe_dropped")
+    balance = (jnp.mean(jnp.stack(aux)) if aux
+               else jnp.zeros((), jnp.float32))
+    loss = ce + jnp.asarray(aux_weight, ce.dtype) * balance.astype(
+        ce.dtype)
+    return loss, {"ppl": jnp.exp(ce),
+                  "moe_balance": balance,
+                  "moe_dropped": (jnp.mean(jnp.stack(dropped)) if dropped
+                                  else jnp.zeros((), jnp.float32))}
 
 
 def choose_remat(cfg: TransformerConfig, batch_size: int,

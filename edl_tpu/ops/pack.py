@@ -131,3 +131,65 @@ def unpack_int8(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
     """Inverse of :func:`pack_int8` (one multiply — no kernel needed;
     XLA fuses it into the consumer)."""
     return dequantize_int8(q, scale)
+
+
+# -- shared collective wires --------------------------------------------------
+# Every cross-chip int8 hop in the tree rides ONE of these two helpers,
+# so the allreduce wire (train/comm._cross_int8, train/dgc.sparse_psum)
+# and the MoE all-to-all wire (train/comm.moe_all_to_all) encode with
+# the same scale/round math and cannot drift: the interpret-mode
+# equivalence pin on pack_int8 covers them all. Both are for use INSIDE
+# shard_map (they issue lax collectives over a named axis).
+
+
+def all_gather_int8(x: jnp.ndarray, axis_name: str, *,
+                    axis_index_groups=None
+                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The int8 GATHER wire: pack -> all_gather(q, scale) -> dequantize.
+
+    ``x`` is one chip's flat (1-D) float contribution. Returns
+    ``(gathered, local)``: the (group, n) fp32 dequantized
+    contributions of every chip in the group, and this chip's own
+    dequantized round-trip (what error-feedback callers subtract to
+    keep the quantization error local). Wire bytes per chip: n int8
+    payload + one fp32 scale.
+    """
+    from jax import lax
+    q, scale = pack_int8(x)
+    all_q = lax.all_gather(q, axis_name,
+                           axis_index_groups=axis_index_groups)
+    all_s = lax.all_gather(scale, axis_name,
+                           axis_index_groups=axis_index_groups)
+    return (dequantize_int8(all_q, all_s[:, None]),
+            dequantize_int8(q, scale))
+
+
+def all_to_all_int8(x: jnp.ndarray, axis_name: str, *,
+                    axis_index_groups=None) -> jnp.ndarray:
+    """The int8 ALL-TO-ALL wire: per-destination-block pack ->
+    all_to_all(q, scales) -> dequantize.
+
+    ``x`` is destination-major: dim 0 enumerates the group's chips (or
+    slices) and block ``x[i]`` is the payload bound for position ``i``
+    of the group. Each block gets its OWN symmetric scale (blocks bound
+    for different destinations have unrelated magnitudes — one global
+    scale would crush the small ones), the int8 payloads and fp32
+    scales ride the same all_to_all pattern, and the receiver
+    dequantizes source-major blocks. Wire bytes per chip: the off-chip
+    payload at 1 byte/element + one fp32 scale per off-chip block. No
+    error feedback — activations are transient; callers bound the
+    rounding error with a loss-parity gate instead (train/comm's MoE
+    dispatch gates).
+    """
+    from jax import lax
+    g = x.shape[0]
+    packed = [pack_int8(x[i]) for i in range(g)]  # static unroll:
+    # keeps the Pallas kernel path per block on TPU (vmap over a
+    # pallas_call would fall back to interpret rules)
+    q = jnp.stack([p[0] for p in packed])
+    scale = jnp.stack([p[1] for p in packed])
+    q_r = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                         tiled=True, axis_index_groups=axis_index_groups)
+    s_r = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
+                         tiled=True, axis_index_groups=axis_index_groups)
+    return dequantize_int8(q_r, s_r.reshape((g,) + (1,) * (x.ndim - 1)))

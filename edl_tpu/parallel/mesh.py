@@ -12,6 +12,7 @@ Axes (any may be size 1):
     fsdp — parameter-sharded data parallel (zero-style)
     tp — tensor parallel (model dim)
     sp — sequence/context parallel (ring attention)
+    ep — expert parallel (MoE expert tables + all-to-all dispatch)
 
 Elasticity: a mesh is a pure function of the device list, so an elastic
 resize is just `make_mesh(spec, n_devices=new_n)` after restart — checkpoint
@@ -38,6 +39,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # The axis whose collectives are allowed to cross the slow DCN boundary.
 DCN_AXIS = "dp"
+
+# The expert-parallel axis. When present it carries the DCN dimension
+# instead of dp: an MoE world's cross-slice traffic is the token
+# all-to-all (train/comm.moe_all_to_all), so experts are laid out
+# slice-local first and only overflow tokens cross DCN.
+EP_AXIS = "ep"
+
+
+def dcn_axis_of(axes) -> str:
+    """The axis carrying the cross-slice (DCN) dimension for a set of
+    mesh axis names: `ep` when present (expert dispatch owns the slow
+    edge), else `dp`."""
+    return EP_AXIS if EP_AXIS in axes else DCN_AXIS
 
 
 @dataclass(frozen=True)
@@ -114,44 +128,48 @@ class MeshSpec:
         """Split each axis size into (dcn, ici) factors against
         (n_slices, chips_per_slice) instead of a flat device count.
 
-        Placement contract: only `dp` crosses DCN — its dcn factor is
-        n_slices; every other axis (and dp's remaining factor) lives
-        inside a slice. An elastic resize that changes EITHER level
-        re-resolves cleanly: the per-slice axes never see the slice
-        count, so adding a slice scales dp without re-factoring
-        fsdp/tp/sp.
+        Placement contract: exactly one axis crosses DCN — `ep` when the
+        spec has one (expert dispatch owns the slow edge; experts are
+        slice-local first and only overflow tokens cross), else `dp` —
+        and its dcn factor is n_slices; every other axis (and the DCN
+        axis's remaining factor) lives inside a slice. An elastic resize
+        that changes EITHER level re-resolves cleanly: the per-slice
+        axes never see the slice count, so adding a slice scales the
+        DCN axis without re-factoring fsdp/tp/sp.
         """
         n_slices, per_slice = topology.n_slices, topology.chips_per_slice
         sizes = dict(self.axes)
-        if n_slices > 1 and DCN_AXIS not in sizes:
+        dcn_name = dcn_axis_of(sizes)
+        if n_slices > 1 and dcn_name not in sizes:
             raise ValueError(
-                f"multi-slice mesh needs a {DCN_AXIS!r} axis to carry the "
-                f"DCN dimension; got axes {list(sizes)}")
+                f"multi-slice mesh needs a {DCN_AXIS!r} (or {EP_AXIS!r}) "
+                f"axis to carry the DCN dimension; got axes {list(sizes)}")
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError("at most one axis may be -1")
-        # dp's in-slice factor: explicit sizes must carry the n_slices
-        # multiple; a wildcard dp absorbs what the slice leaves over.
-        dp_total = sizes.get(DCN_AXIS, 1)
-        if dp_total != -1 and dp_total % n_slices != 0:
+        # the DCN axis's in-slice factor: explicit sizes must carry the
+        # n_slices multiple; a wildcard absorbs what the slice leaves.
+        dcn_total = sizes.get(dcn_name, 1)
+        if dcn_total != -1 and dcn_total % n_slices != 0:
             raise ValueError(
-                f"{DCN_AXIS}={dp_total} not divisible by n_slices="
-                f"{n_slices} (dp's major component spans the slices)")
+                f"{dcn_name}={dcn_total} not divisible by n_slices="
+                f"{n_slices} ({dcn_name}'s major component spans the "
+                f"slices)")
         ici_fixed = int(np.prod(
-            [v for k, v in sizes.items() if v != -1 and k != DCN_AXIS]))
-        if dp_total != -1:
-            ici_fixed *= dp_total // n_slices
+            [v for k, v in sizes.items() if v != -1 and k != dcn_name]))
+        if dcn_total != -1:
+            ici_fixed *= dcn_total // n_slices
         if wild:
             if per_slice % ici_fixed != 0:
                 raise ValueError(
                     f"chips_per_slice={per_slice} not divisible by fixed "
                     f"in-slice axes of {sizes}")
-            if wild[0] == DCN_AXIS:
-                sizes[DCN_AXIS] = n_slices * (per_slice // ici_fixed)
+            if wild[0] == dcn_name:
+                sizes[dcn_name] = n_slices * (per_slice // ici_fixed)
             else:
                 sizes[wild[0]] = per_slice // ici_fixed
-        dcn = {k: (n_slices if k == DCN_AXIS else 1) for k in sizes}
-        ici = {k: (v // n_slices if k == DCN_AXIS else v)
+        dcn = {k: (n_slices if k == dcn_name else 1) for k in sizes}
+        ici = {k: (v // n_slices if k == dcn_name else v)
                for k, v in sizes.items()}
         if int(np.prod(list(ici.values()))) != per_slice:
             raise ValueError(
@@ -249,6 +267,28 @@ def dp_comm_groups(n_slices: int, chips_per_slice: int
     cross = [[s * chips_per_slice + c for s in range(n_slices)]
              for c in range(chips_per_slice)]
     return intra, cross
+
+
+def ep_comm_groups(n_slices: int, chips_per_slice: int
+                   ) -> tuple[list[list[int]], list[list[int]]]:
+    """(intra-slice, cross-slice) ``axis_index_groups`` over a
+    slice-major ep axis — the expert-dispatch mirror of
+    :func:`dp_comm_groups`.
+
+    `make_hybrid_mesh` lays the ep axis out slice-major exactly like
+    dp (ep index ``e = s * chips_per_slice + c``), so the group
+    arithmetic is identical; what differs is what rides them: the
+    intra groups carry the ICI all-to-all leg among a slice's
+    co-resident experts (tokens reach the E/S experts in their own
+    slice without touching DCN), and the cross groups carry only the
+    OVERFLOW tokens routed to another slice's experts — the DCN leg of
+    `train/comm.moe_all_to_all`, the one the int8 wire compresses.
+    """
+    if n_slices < 1 or chips_per_slice < 1:
+        raise ValueError(
+            f"ep_comm_groups needs positive factors, got "
+            f"{n_slices}x{chips_per_slice}")
+    return dp_comm_groups(n_slices, chips_per_slice)
 
 
 def data_sharding(mesh: Mesh, batch_axes: tuple[str, ...] | None = None

@@ -41,6 +41,14 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
     # activations, not the (V, d) table.
     ("vocab_table", None),
     ("embed_table", "tp"),
+    # MoE expert tables: the leading expert dim shards over ep, so the
+    # checkpoint index carries each table as ep-sharded leaves and the
+    # cross-mesh resharding planner (train/sharded_checkpoint.py +
+    # collective/migration.py) re-shards experts on an ep resize like
+    # any other sharded state. The router's expert dim stays replicated
+    # (expert_router) — every chip routes against all experts.
+    ("expert", "ep"),
+    ("expert_router", None),
 )
 
 

@@ -282,16 +282,14 @@ def _cross_topk(shard, resid, axis, groups, k):
 
 def _cross_int8(shard, resid, axis, groups):
     """int8 DCN edge: per-chip symmetric scale, error feedback keeps
-    the quantization error local and re-contributed."""
-    from edl_tpu.ops.pack import (dequantize_int8, pack_int8,
-                                  unpack_int8)
+    the quantization error local and re-contributed. Rides the shared
+    gather wire (ops/pack.all_gather_int8) — same codec as the DGC
+    value wire and the MoE dispatch wire."""
+    from edl_tpu.ops.pack import all_gather_int8
     u = shard + resid
-    q, scale = pack_int8(u)
-    all_q = lax.all_gather(q, axis, axis_index_groups=groups)
-    all_s = lax.all_gather(scale, axis, axis_index_groups=groups)
-    dense = jnp.sum(dequantize_int8(all_q, all_s[:, None])
-                    .astype(u.dtype), axis=0)
-    return dense, u - unpack_int8(q, scale).astype(u.dtype)
+    gathered, local = all_gather_int8(u, axis, axis_index_groups=groups)
+    dense = jnp.sum(gathered.astype(u.dtype), axis=0)
+    return dense, u - local.astype(u.dtype)
 
 
 def _reduce_bucket(buf, resid, *, axis: str, n_slices: int, chips: int,
@@ -349,6 +347,22 @@ def _validate_dp_mesh(mesh) -> str:
     return "dp"
 
 
+def _validate_ep_mesh(mesh) -> str:
+    """The manual MoE path owns ep-only meshes; return the axis name."""
+    if "ep" not in mesh.axis_names:
+        raise ValueError(
+            f"MoE comm step needs an ep axis; mesh axes "
+            f"{mesh.axis_names}")
+    for name in mesh.axis_names:
+        if name != "ep" and mesh.shape[name] != 1:
+            raise ValueError(
+                "MoE comm step owns ep-only meshes (token dispatch is "
+                f"the cross-slice traffic); axis {name!r} has size "
+                f"{mesh.shape[name]} — keep the XLA-partitioned step "
+                "for mixed meshes")
+    return "ep"
+
+
 class CommTrainStep:
     """``(state, batch) -> (state, metrics)`` with the manual bucketed
     gradient path. Drop-in for TrainLoop; the error-feedback residuals
@@ -372,7 +386,7 @@ class CommTrainStep:
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.config = config
-        self.axis = _validate_dp_mesh(mesh)
+        self.axis = self._validate_mesh(mesh)
         self.world = int(mesh.shape[self.axis])
         topology = topology or mesh_lib.SliceTopology(1, self.world)
         if self.world % topology.n_slices:
@@ -404,6 +418,9 @@ class CommTrainStep:
                      "(DCN) gradient legs")
         except Exception:  # noqa: BLE001 — observability is optional
             pass
+
+    def _validate_mesh(self, mesh) -> str:
+        return _validate_dp_mesh(mesh)
 
     # -- static accounting (bench/obs surface) ------------------------------
 
@@ -449,13 +466,14 @@ class CommTrainStep:
     # -- build ---------------------------------------------------------------
 
     def _residual_init(self):
-        from edl_tpu.parallel.sharding import dp_row_sharding
         res = []
         for b in self.plan.buckets:
             m = b.padded // self.chips if _needs_residual(
                 b, self.chips, self.n_slices, self.config) else 0
             res.append(jnp.zeros((self.world, m), b.dtype))
-        sharding = dp_row_sharding(self.mesh)
+        # one distinct row per axis position (dp_row_sharding
+        # generalized to whichever axis this step owns — ep for MoE)
+        sharding = NamedSharding(self.mesh, P(self.axis))
         return tuple(jax.device_put(r, sharding) for r in res)
 
     def _build(self, state, batch):
@@ -559,6 +577,296 @@ def make_comm_train_step(loss_fn: Callable, *, mesh,
     return CommTrainStep(loss_fn, mesh=mesh,
                          config=config or CommConfig(),
                          topology=topology, donate=donate)
+
+
+# -- MoE: hierarchical all-to-all dispatch -----------------------------------
+#
+# The expert-parallel analogue of the bucketed gradient path above: an
+# MoE layer's hot collective is the token all-to-all (dispatch to the
+# expert owners, combine back), and on a hybrid topology it decomposes
+# the same way the dp reduction does — an ICI leg inside the slice
+# (tokens reach their slice's E/S co-resident experts without touching
+# DCN) and a cross-slice DCN leg carrying only the OVERFLOW tokens
+# routed to another slice's experts, optionally int8 on the wire
+# (ops/pack.all_to_all_int8 — the same codec as the gradient legs).
+# The decomposition is a pure permutation: uncompressed it is BITWISE
+# identical to the single flat collective (moe_parity_gate pins this),
+# and the int8 leg sits behind the same loss-envelope + convergence
+# discipline as the gradient wire.
+
+MOE_DISPATCH_MODES = ("flat", "hier")
+MOE_COMPRESS_MODES = ("off", "int8")
+
+
+@dataclass(frozen=True)
+class MoEDispatchConfig:
+    """Knobs of the manual MoE dispatch path.
+
+    mode: 'flat' (one all-to-all over the whole ep axis — the single-
+      collective baseline) or 'hier' (ICI leg + DCN overflow leg;
+      EDL_TPU_MOE_DISPATCH).
+    compress: DCN-leg wire format (EDL_TPU_MOE_COMPRESS) — 'off'
+      (dense, bitwise with flat) or 'int8' (per-destination-block
+      symmetric scale). int8 requires mode='hier': only the
+      decomposed path has a separate DCN leg to compress.
+    """
+
+    mode: str = "hier"
+    compress: str = "off"
+
+    def __post_init__(self):
+        if self.mode not in MOE_DISPATCH_MODES:
+            raise ValueError(
+                f"mode must be one of {MOE_DISPATCH_MODES}, "
+                f"got {self.mode!r}")
+        if self.compress not in MOE_COMPRESS_MODES:
+            raise ValueError(
+                f"compress must be one of {MOE_COMPRESS_MODES}, "
+                f"got {self.compress!r}")
+        if self.compress != "off" and self.mode != "hier":
+            raise ValueError(
+                "compress needs mode='hier' — the flat single "
+                "collective has no separate DCN leg to compress")
+
+
+def moe_all_to_all(x, *, axis: str, n_slices: int, chips: int,
+                   mode: str = "hier", compress: str = "off"):
+    """Destination-major block transport over the ep axis.
+
+    ``x`` is (W, ...) on every chip: block ``x[w]`` is this chip's
+    payload bound for chip ``w`` (W = n_slices * chips, slice-major).
+    Returns the source-major received buffer of the same shape.
+
+    'flat': one ``lax.all_to_all`` over the whole axis. 'hier': the
+    two-level decomposition — an ICI all-to-all within each slice
+    delivers every block to the chip IN ITS OWN SLICE holding the
+    destination's intra-slice position, then a cross-slice all-to-all
+    over the stride-C columns (mesh.ep_comm_groups) carries the
+    off-slice blocks over DCN. A pure permutation: bitwise identical
+    to 'flat' when uncompressed. compress='int8' quantizes only the
+    DCN leg (per-destination-slice symmetric scales); the slice-local
+    blocks never leave ICI and stay exact.
+    """
+    w = n_slices * chips
+    if x.shape[0] != w:
+        raise ValueError(
+            f"dest-major dim {x.shape[0]} != world {n_slices}x{chips}")
+    if mode == "flat" or n_slices <= 1:
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+    intra, cross = mesh_lib.ep_comm_groups(n_slices, chips)
+    b = x.reshape((n_slices, chips) + x.shape[1:])
+    if chips > 1:
+        b = lax.all_to_all(b, axis, split_axis=1, concat_axis=1,
+                           tiled=True, axis_index_groups=intra)
+    if compress == "int8" and jnp.issubdtype(b.dtype, jnp.floating):
+        from edl_tpu.ops.pack import all_to_all_int8
+        b = all_to_all_int8(b, axis,
+                            axis_index_groups=cross).astype(x.dtype)
+    else:
+        b = lax.all_to_all(b, axis, split_axis=0, concat_axis=0,
+                           tiled=True, axis_index_groups=cross)
+    return b.reshape(x.shape)
+
+
+def moe_leg_bytes(block_elems: int, itemsize: int, n_slices: int,
+                  chips: int, compress: str) -> int:
+    """Cross-slice bytes ONE chip sends for one dispatch/combine leg
+    whose per-destination-chip block has ``block_elems`` elements —
+    the same payload-only accounting as :func:`dcn_bytes_per_step`
+    (off-slice blocks only; the slice-local blocks ride ICI free)."""
+    if n_slices <= 1:
+        return 0
+    off = (n_slices - 1) * chips * block_elems
+    if compress == "int8":
+        return off * 1 + (n_slices - 1) * 4  # int8 payload + fp32 scales
+    return off * itemsize
+
+
+@dataclass
+class MoEWire:
+    """The transport a manual-region MoE layer dispatches through
+    (models/transformer.MoEMLP's ``cfg.moe_wire`` contract): buffer
+    reshapes + the grouped collectives, with a trace-time ``on_leg``
+    hook so the owning step can account wire bytes statically.
+
+    dispatch: (E, cap, d) per-chip dispatch buffer -> (E/W, W*cap, d)
+      tokens received for this chip's local experts.
+    combine: the inverse — (E/W, W*cap, d) expert outputs back to
+      (E, cap, d) at the token owners.
+    local_slice: (E, ...) replicated table -> this chip's (E/W, ...)
+      expert rows (the in-region view of what the checkpoint stores
+      ep-sharded).
+    """
+
+    axis: str
+    n_slices: int
+    chips: int
+    config: MoEDispatchConfig
+    on_leg: Callable | None = None
+
+    @property
+    def world(self) -> int:
+        return self.n_slices * self.chips
+
+    def _transport(self, x):
+        if self.on_leg is not None:
+            self.on_leg(tuple(int(v) for v in x.shape),
+                        np.dtype(x.dtype).itemsize)
+        return moe_all_to_all(x, axis=self.axis,
+                              n_slices=self.n_slices, chips=self.chips,
+                              mode=self.config.mode,
+                              compress=self.config.compress)
+
+    def dispatch(self, buf):
+        e, cap, d = buf.shape
+        w = self.world
+        if e % w:
+            raise ValueError(f"n_experts={e} not divisible by ep "
+                             f"world {w}")
+        el = e // w
+        r = self._transport(buf.reshape(w, el, cap, d))
+        return r.transpose(1, 0, 2, 3).reshape(el, w * cap, d)
+
+    def combine(self, out):
+        el, wcap, d = out.shape
+        w = self.world
+        cap = wcap // w
+        r = self._transport(
+            out.reshape(el, w, cap, d).transpose(1, 0, 2, 3))
+        return r.reshape(w * el, cap, d)
+
+    def local_slice(self, table):
+        el = table.shape[0] // self.world
+        i = lax.axis_index(self.axis)
+        return lax.dynamic_slice_in_dim(table, i * el, el, axis=0)
+
+
+class MoECommStep(CommTrainStep):
+    """``(state, batch) -> (state, metrics)`` for an expert-parallel
+    transformer over an ep-only mesh: the bucketed gradient reduction
+    of :class:`CommTrainStep` (over ep — each chip's local-mean grads
+    x 1/W then psum; an expert table's grad is nonzero only on its
+    owner chip, so the same reduction assembles every expert exactly
+    once) plus the hierarchical token dispatch injected into the model
+    as its ``moe_wire``.
+
+    Built from a loss FACTORY rather than a loss_fn: the factory
+    receives the wire and returns a mesh-free ``loss_fn(state, params,
+    batch)`` whose MoE layers transport through it (rebind the model
+    config's ``moe_wire`` — params are untouched, so states move
+    between the jit path and this one freely).
+    """
+
+    def __init__(self, loss_factory: Callable, *, mesh,
+                 config: CommConfig | None = None,
+                 moe_config: MoEDispatchConfig | None = None,
+                 topology=None, donate: bool = True):
+        moe_config = moe_config or MoEDispatchConfig()
+        _validate_ep_mesh(mesh)
+        world = int(mesh.shape["ep"])
+        topology = topology or mesh_lib.SliceTopology(1, world)
+        # flat world + hier dispatch: every chip its own slice (S=W,
+        # C=1) — the same degeneration the gradient path uses, so CPU
+        # worlds exercise the full DCN wire (incl. int8) without
+        # emulated slices.
+        if moe_config.mode == "hier" and not topology.is_multi_slice:
+            self._moe_slices, self._moe_chips = world, 1
+        else:
+            self._moe_slices = topology.n_slices
+            self._moe_chips = world // topology.n_slices
+        self.moe_config = moe_config
+        self._legs: list[tuple[tuple, int]] = []
+        self.wire = MoEWire(axis="ep", n_slices=self._moe_slices,
+                            chips=self._moe_chips, config=moe_config,
+                            on_leg=self._record_leg)
+        super().__init__(loss_factory(self.wire), mesh=mesh,
+                         config=config or CommConfig(),
+                         topology=topology, donate=donate)
+        self._moe_counter = None
+        try:
+            from edl_tpu.obs import metrics as obs_metrics
+            self._moe_counter = obs_metrics.registry().counter(
+                "step_moe_dcn_bytes",
+                help="bytes this process contributed to cross-slice "
+                     "(DCN) MoE dispatch/combine legs")
+        except Exception:  # noqa: BLE001 — observability is optional
+            pass
+
+    def _validate_mesh(self, mesh) -> str:
+        return _validate_ep_mesh(mesh)
+
+    def _record_leg(self, shape: tuple, itemsize: int):
+        # trace-time hook: legs are recorded once, during the first
+        # call's trace (self.steps is still 0) — retraces don't
+        # double-count
+        if self.steps == 0:
+            self._legs.append((shape, itemsize))
+
+    # -- static accounting (bench/obs surface) ------------------------------
+
+    def moe_dcn_bytes_per_step(self) -> int:
+        """Per-chip cross-slice dispatch+combine payload bytes each
+        step (0 until the first call traces the wire)."""
+        compress = (self.moe_config.compress
+                    if self.moe_config.mode == "hier" else "off")
+        total = 0
+        for shape, itemsize in self._legs:
+            block = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+            total += moe_leg_bytes(block, itemsize, self._moe_slices,
+                                   self._moe_chips, compress)
+        return total
+
+    def moe_dispatch_overlap_pct(self) -> float:
+        """Share of cross-slice dispatch bytes whose leg can be in
+        flight under other layers' expert compute — every leg except
+        the final combine (legs of layer i overlap layer i±1's expert
+        FFNs). A SCHEDULE property like dcn_overlap_pct: the CPU
+        harness has no DCN to overlap; on hardware, read the
+        profiler."""
+        n = len(self._legs)
+        if n <= 1 or self._moe_slices <= 1:
+            return 0.0
+        return round(100.0 * (n - 1) / n, 2)
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update({"moe_dispatch": self.moe_config.mode,
+                    "moe_compress": self.moe_config.compress,
+                    "moe_dispatch_legs": len(self._legs),
+                    "moe_dcn_bytes_per_step":
+                        self.moe_dcn_bytes_per_step(),
+                    "moe_dispatch_overlap_pct":
+                        self.moe_dispatch_overlap_pct()})
+        return out
+
+    def __call__(self, state, batch):
+        from edl_tpu.obs import trace
+        if trace.enabled():
+            with trace.span("step.moe_dispatch",
+                            attrs={"mode": self.moe_config.mode,
+                                   "compress": self.moe_config.compress,
+                                   "moe_dcn_bytes":
+                                       self.moe_dcn_bytes_per_step()}):
+                out = super().__call__(state, batch)
+        else:
+            out = super().__call__(state, batch)
+        if self._moe_counter is not None:
+            self._moe_counter.inc(self.moe_dcn_bytes_per_step())
+        return out
+
+
+def make_moe_comm_step(loss_factory: Callable, *, mesh,
+                       config: CommConfig | None = None,
+                       moe_config: MoEDispatchConfig | None = None,
+                       topology=None, donate: bool = True
+                       ) -> MoECommStep:
+    """Build the manual MoE step. ``loss_factory(wire) -> loss_fn``;
+    returns a TrainLoop-compatible ``step(state, batch)`` callable
+    carrying dispatch wire accounting in ``.stats()``."""
+    return MoECommStep(loss_factory, mesh=mesh, config=config,
+                       moe_config=moe_config, topology=topology,
+                       donate=donate)
 
 
 # -- the parity gate ---------------------------------------------------------
@@ -764,6 +1072,198 @@ def convergence_smoke(compress: str = "topk", steps: int = 40,
     return report
 
 
+# -- MoE dispatch gates -------------------------------------------------------
+
+
+def moe_parity_gate(loss_factory: Callable, state, batch, *, mesh,
+                    moe_config: MoEDispatchConfig | None = None,
+                    comm_config: CommConfig | None = None,
+                    topology=None, steps: int = 3,
+                    envelope: float = 0.1) -> dict:
+    """The gate the bench must pass before reporting MoE DCN numbers —
+    the r21 discipline applied to the dispatch wire.
+
+    1. hier-UNCOMPRESSED vs the flat single collective: identical
+       params AND per-step losses after ``steps`` steps, bitwise
+       (``bitwise_hier`` — the decomposition is a pure permutation, so
+       anything less is a wiring bug, not float noise).
+    2. if ``moe_config.compress != 'off'``: the compressed path's
+       per-step loss stays within ``envelope`` of flat's
+       (``loss_envelope_ok`` / ``max_loss_delta``). The default is
+       wider than the gradient gate's: int8 here rides ACTIVATIONS
+       (transient — no error-feedback residual to reclaim rounding),
+       so per-step deltas are real quantization noise, a few percent
+       of a from-init loss; the convergence smoke's RELATIVE envelope
+       is the binding check on whether that noise costs learning.
+
+    Both arms are MoECommStep instances — jit-vs-manual is NOT gated
+    here: the manual region routes per CHIP (local capacity) while the
+    jit dense path routes per GLOBAL batch, a documented semantic
+    delta covered by the convergence smoke's relative envelope.
+    Callers hand in a throwaway state (every arm trains from it).
+    """
+    moe_config = moe_config or MoEDispatchConfig()
+    placed = mesh_lib.shard_batch(mesh, batch, batch_axes=("ep",))
+    rep = lambda t: jax.device_put(  # noqa: E731
+        t, NamedSharding(mesh, P()))
+
+    def run(mcfg):
+        step = MoECommStep(loss_factory, mesh=mesh,
+                           config=comm_config, moe_config=mcfg,
+                           topology=topology, donate=False)
+        s = jax.tree.map(rep, state)
+        losses = []
+        for _ in range(steps):
+            s, m = step(s, placed)
+            losses.append(float(m["loss"]))
+        return s, losses
+
+    s_flat, l_flat = run(MoEDispatchConfig(mode="flat"))
+    s_hier, l_hier = run(MoEDispatchConfig(mode="hier"))
+    gate = {"bitwise_hier": tree_bitwise_equal(s_flat.params,
+                                               s_hier.params)
+            and l_flat == l_hier,
+            "hier_loss_delta": max(abs(a - b)
+                                   for a, b in zip(l_flat, l_hier)),
+            "envelope": envelope, "steps": steps}
+    if moe_config.compress != "off":
+        _, l_comp = run(moe_config)
+        deltas = [abs(a - b) for a, b in zip(l_flat, l_comp)]
+        gate["max_loss_delta"] = max(deltas)
+        gate["loss_envelope_ok"] = max(deltas) <= envelope
+    gate["ok"] = bool(gate["bitwise_hier"]
+                      and gate.get("loss_envelope_ok", True))
+    return gate
+
+
+def _smoke_moe(world: int):
+    """Tiny MoE markov-LM: returns ``(loss_factory, jit_loss_fn,
+    state, batch)``. The factory closes over the wire for the manual
+    step; the jit loss runs the dense-einsum dispatch (wire=None) on
+    the same params."""
+    import functools
+
+    import optax
+    from flax.core import meta
+
+    from edl_tpu.models.transformer import (Transformer,
+                                            TransformerConfig,
+                                            lm_loss_moe)
+    from edl_tpu.train.state import TrainState
+
+    vocab, seq = 32, 16
+    gen = np.random.default_rng(23)
+    successors = gen.integers(0, vocab, size=(vocab, 4))
+    toks = np.empty((4 * world, seq), np.int32)
+    toks[:, 0] = gen.integers(0, vocab, size=4 * world)
+    for t in range(1, seq):
+        pick = gen.integers(0, 4, size=4 * world)
+        toks[:, t] = successors[toks[:, t - 1], pick]
+    cfg = TransformerConfig(vocab_size=vocab, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=seq,
+                            dtype=jnp.float32, mesh=None, moe=True,
+                            n_experts=2 * world, moe_top_k=2)
+    model = Transformer(cfg)
+    variables = meta.unbox(model.init(jax.random.PRNGKey(0),
+                                      jnp.asarray(toks), train=False))
+    state = TrainState.create(apply_fn=model.apply,
+                              params=variables["params"],
+                              tx=optax.sgd(0.5, momentum=0.9))
+
+    def loss_factory(wire):
+        wired = Transformer(dataclasses.replace(cfg, moe_wire=wire))
+        return functools.partial(lm_loss_moe,
+                                 aux_weight=cfg.moe_aux_weight,
+                                 apply_fn=wired.apply)
+
+    jit_loss = functools.partial(lm_loss_moe,
+                                 aux_weight=cfg.moe_aux_weight)
+    return loss_factory, jit_loss, state, {"tokens": toks}
+
+
+def moe_convergence_smoke(compress: str = "int8", steps: int = 40,
+                          envelope: float = 0.25,
+                          topology=None) -> dict:
+    """MoE convergence smoke (the ``smoke --moe`` CI gate): train the
+    hierarchical+compressed dispatch against the uncompressed manual
+    baseline from the same init. Both must LEARN and the compressed
+    run must keep at least ``1 - envelope`` of the baseline's loss
+    improvement — the relative envelope of `convergence_smoke`, but
+    SIGNED: only a compressed run that converges WORSE by more than
+    ``envelope * improvement`` fails (at lr high enough to learn in
+    40 steps, trajectories diverge chaotically under any per-step
+    noise, and int8 dispatch noise can just as well land ahead of the
+    baseline — penalizing |delta| would fail runs that beat dense).
+    The flat/off MoECommStep is the dense reference so the
+    envelope isolates the wire (per-chip routing is identical in both
+    arms; the jit path's global-capacity routing delta is reported as
+    ``jit_loss_final`` for the learned check, not gated). Runs the
+    bitwise flat-vs-hier parity gate first; a red gate fails the
+    smoke regardless of convergence."""
+    world = jax.device_count()
+    mesh = (mesh_lib.make_hybrid_mesh(mesh_lib.MeshSpec({"ep": -1}),
+                                      topology)
+            if topology is not None and topology.is_multi_slice
+            else mesh_lib.make_mesh(mesh_lib.MeshSpec({"ep": -1})))
+    loss_factory, jit_loss, state, batch = _smoke_moe(world)
+    placed = mesh_lib.shard_batch(mesh, batch, batch_axes=("ep",))
+    rep = lambda t: jax.device_put(  # noqa: E731
+        t, NamedSharding(mesh, P()))
+    comm_cfg = CommConfig(bucket_mb=0.25)
+
+    gate = moe_parity_gate(
+        loss_factory, state, batch, mesh=mesh, topology=topology,
+        comm_config=comm_cfg,
+        moe_config=MoEDispatchConfig(mode="hier", compress=compress))
+
+    def run(mcfg):
+        step = MoECommStep(loss_factory, mesh=mesh, config=comm_cfg,
+                           moe_config=mcfg, topology=topology,
+                           donate=False)
+        s = jax.tree.map(rep, state)
+        first = last = None
+        for _ in range(steps):
+            s, m = step(s, placed)
+            if first is None:
+                first = float(m["loss"])
+            last = float(m["loss"])
+        return first, last, step
+
+    first, dense_last, _ = run(MoEDispatchConfig(mode="flat"))
+    _, comp_last, comp_step = run(
+        MoEDispatchConfig(mode="hier", compress=compress))
+
+    from edl_tpu.train.step import make_train_step
+    jit_step = make_train_step(jit_loss, donate=False)
+    s_jit = jax.tree.map(rep, state)
+    jit_last = None
+    for _ in range(steps):
+        s_jit, m = jit_step(s_jit, placed)
+        jit_last = float(m["loss"])
+
+    delta = comp_last - dense_last  # signed: + = compression cost
+    improvement = max(first - dense_last, 1e-9)
+    report = {
+        "compress": compress, "steps": steps, "envelope": envelope,
+        "world": world,
+        "n_slices": topology.n_slices if topology else 1,
+        "parity_gate": gate,
+        "loss_initial": round(first, 4),
+        "loss_dense": round(dense_last, 4),
+        "loss_compressed": round(comp_last, 4),
+        "jit_loss_final": round(jit_last, 4),
+        "delta": round(delta, 5),
+        "delta_rel": round(delta / improvement, 5),
+        "learned": dense_last < first and comp_last < first,
+        "within_envelope": delta <= envelope * improvement,
+        "moe_dcn_bytes_per_step": comp_step.moe_dcn_bytes_per_step(),
+        "moe_dispatch_overlap_pct":
+            comp_step.moe_dispatch_overlap_pct()}
+    report["ok"] = bool(gate["ok"] and report["learned"]
+                        and report["within_envelope"])
+    return report
+
+
 def _main(argv=None) -> int:
     import argparse
     import json
@@ -774,7 +1274,14 @@ def _main(argv=None) -> int:
         "smoke", help="convergence-parity smoke: compressed DCN leg vs "
                       "dense jit on the CNN + transformer tinies")
     smoke.add_argument("--compress", choices=("topk", "int8"),
-                       default="topk")
+                       default=None,
+                       help="DCN wire format (default: topk; int8 "
+                            "with --moe, which has no topk wire)")
+    smoke.add_argument("--moe", action="store_true",
+                       help="run the MoE dispatch smoke (hier+int8 "
+                            "all-to-all vs the flat/off manual "
+                            "baseline, bitwise parity gate first) "
+                            "instead of the gradient-wire smokes")
     smoke.add_argument("--steps", type=int, default=40)
     smoke.add_argument("--envelope", type=float, default=0.25,
                        help="RELATIVE loss envelope: the compressed "
@@ -790,7 +1297,19 @@ def _main(argv=None) -> int:
             raise SystemExit(f"{world} devices not divisible by "
                              f"--slices {args.slices}")
         topo = mesh_lib.SliceTopology(args.slices, world // args.slices)
-    report = convergence_smoke(compress=args.compress, steps=args.steps,
+    if args.moe:
+        compress = args.compress or "int8"
+        if compress == "topk":
+            raise SystemExit("--moe supports --compress int8 only "
+                             "(token blocks have no sparse wire)")
+        report = moe_convergence_smoke(compress=compress,
+                                       steps=args.steps,
+                                       envelope=args.envelope,
+                                       topology=topo)
+        print(json.dumps({"moe_smoke": report}))
+        return 0 if report["ok"] else 1
+    report = convergence_smoke(compress=args.compress or "topk",
+                               steps=args.steps,
                                envelope=args.envelope, topology=topo)
     print(json.dumps({"comm_smoke": report}))
     return 0 if report["ok"] else 1

@@ -191,14 +191,13 @@ def sparse_psum(tree, axis_name: str, keep_frac: float = 0.01,
         vals = flat[idx]  # signed values at the top-|.| positions
         # (group, k) after gather — the ONLY cross-worker bytes
         if wire == "int8":
-            from edl_tpu.ops.pack import dequantize_int8, pack_int8
-            q, scale = pack_int8(vals)
-            all_q = lax.all_gather(q, axis_name,
-                                   axis_index_groups=axis_index_groups)
-            all_s = lax.all_gather(scale, axis_name,
-                                   axis_index_groups=axis_index_groups)
-            all_vals = dequantize_int8(all_q,
-                                       all_s[:, None]).astype(v.dtype)
+            # the shared gather wire (ops/pack.all_gather_int8): one
+            # codec for this value wire, the comm DCN leg, and the MoE
+            # dispatch — drift between them is structurally impossible
+            from edl_tpu.ops.pack import all_gather_int8
+            all_vals, _ = all_gather_int8(
+                vals, axis_name, axis_index_groups=axis_index_groups)
+            all_vals = all_vals.astype(v.dtype)
         else:
             all_vals = lax.all_gather(
                 vals, axis_name, axis_index_groups=axis_index_groups)

@@ -91,6 +91,14 @@ class LoopConfig:
     # step (--dcn-compress / --comm-bucket-mb override).
     comm_bucket_mb: float = field(0.0, env="EDL_TPU_COMM_BUCKET_MB")
     dcn_compress: str = field("off", env="EDL_TPU_DCN_COMPRESS")
+    # Expert-parallel dispatch (train/comm.py MoE section): how the
+    # token all-to-all decomposes (flat single collective | hier =
+    # ICI leg + cross-slice DCN leg) and the DCN leg's wire format
+    # (off | int8, one scale per destination slice, parity-gated).
+    # Entrypoints read these for --moe runs (--moe-dispatch /
+    # --moe-compress override).
+    moe_dispatch: str = field("hier", env="EDL_TPU_MOE_DISPATCH")
+    moe_compress: str = field("off", env="EDL_TPU_MOE_COMPRESS")
     # Fused optimizer path (train/fused_opt.py): the whole momentum-SGD
     # / Adam update as one Pallas VMEM pass per parameter bucket.
     # off = the optax chain; fp32 = fused, bitwise vs optax; int8/fp8 =

@@ -9,6 +9,12 @@ intersect it — saved-mesh and restore-mesh shapes are independent, so an
 fsdp x tp state saved on 8 devices re-places onto 4 (or 32) by the
 target's sharding rules. Chunk reads go through numpy memory-maps, so
 restore materializes per-target-shard regions, never the full array.
+The planner is sharding-GENERIC: MoE expert tables (leading "expert"
+logical axis -> ep, sharding.DEFAULT_RULES) are ordinary sharded
+leaves here, so an ep resize (4 -> 2 experts-per-chip doubling, or
+back) reshards expert tables through this same path — from disk or,
+via ``restore_from_index`` with a peer-fetch loader, from donor
+memory (collective/migration.py) with zero process restarts.
 
 Save splits into two halves so the async checkpoint plane
 (train/checkpoint.py `save_async`) can run them on different threads:

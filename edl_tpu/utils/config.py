@@ -105,6 +105,10 @@ ENV_VARS: dict[str, str] = {
                               "(0 = XLA-partitioned single reduction)",
     "EDL_TPU_DCN_COMPRESS": "cross-slice gradient wire format: "
                             "off | topk | int8 (loss-parity gated)",
+    "EDL_TPU_MOE_DISPATCH": "MoE all-to-all decomposition: flat | hier "
+                            "(ICI leg + cross-slice DCN leg)",
+    "EDL_TPU_MOE_COMPRESS": "MoE dispatch DCN-leg wire format: "
+                            "off | int8 (parity-gated)",
     "EDL_TPU_FUSED_OPT": "fused optimizer path: off | fp32 | int8 | fp8 "
                          "(train/fused_opt.py; fp32 is bitwise vs optax, "
                          "int8/fp8 quantize resident moments)",
