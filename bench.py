@@ -1829,6 +1829,60 @@ def bench_serving_slo(on_tpu: bool) -> dict:
         "serving_per_trace": per_trace}
 
 
+def bench_fleet(on_tpu: bool) -> dict:
+    """Fleet-scale scheduling quality on the deterministic `FleetSim`
+    (edl_tpu/scaler/fleet): hundreds of concurrent trainer jobs and
+    serving pools from a seeded trace, every resize priced by the
+    measured downtime ladder (0.061s p2p adopt / 0.138s in-place
+    reform / 1.2s stop-resume).
+
+    Reduced-scale cut of the tools/fleet_bench.py tournament so the
+    artifact stays cheap: the preemptive policy vs plain fair-share on
+    the spot-heavy trace (SLO attainment at equal-or-better goodput is
+    the claim), and the spot-riding experiment (80% revocable capacity
+    vs all-reserved; the ratio is the price of living on spot when
+    every preemption notice is ridden as a scheduled seal-and-shrink).
+    Deterministic (seeded sim, virtual clock), so regressions here are
+    policy regressions."""
+    from edl_tpu.scaler.fleet import FleetSim, FleetTrace, run_fleet
+    from edl_tpu.scaler.fleet_policy import (FairSharePolicy,
+                                             PreemptiveFairSharePolicy)
+    del on_tpu  # host-side decision plane: identical on every platform
+    kw = dict(cooldown_s=15.0, horizon_s=60.0)
+    scale = dict(n_jobs=72, n_pools=12, ticks=160)
+    trace = FleetTrace.generate("spot-heavy", 13, spot_fraction=0.5,
+                                churn=0.15, **scale)
+    fair = run_fleet(FleetSim(trace), FairSharePolicy(1, **kw))
+    pre = run_fleet(FleetSim(trace),
+                    PreemptiveFairSharePolicy(1, **kw))
+    ride = {}
+    for key, frac in (("reserved", 0.0), ("spot80", 0.8)):
+        t = FleetTrace.generate("spot-ride", 21, spot_fraction=frac,
+                                **scale)
+        ride[key] = run_fleet(FleetSim(t),
+                              PreemptiveFairSharePolicy(1, **kw))
+    return {
+        "fleet_jobs": len(trace.jobs),
+        "fleet_pools": len(trace.pools),
+        "fleet_goodput_rows_per_s": pre["goodput_rows_per_s"],
+        "fleet_goodput_fair_share_rows_per_s":
+            fair["goodput_rows_per_s"],
+        "fleet_slo_attainment": pre["slo_attainment"],
+        "fleet_slo_attainment_fair_share": fair["slo_attainment"],
+        "fleet_jain_fairness": pre["jain_fairness"],
+        "fleet_forced_evictions": pre["forced_evictions"],
+        "fleet_forced_evictions_fair_share": fair["forced_evictions"],
+        "fleet_lost_rows": pre["lost_rows"],
+        "fleet_lost_rows_fair_share": fair["lost_rows"],
+        "fleet_spot80_goodput_ratio": round(
+            ride["spot80"]["goodput_rows_per_s"]
+            / max(ride["reserved"]["goodput_rows_per_s"], 1e-9), 4),
+        "fleet_spot80_notices_ridden": ride["spot80"]["notices_ridden"],
+        "fleet_spot80_notices_issued": ride["spot80"]["notices_issued"],
+        "fleet_spot80_forced_evictions":
+            ride["spot80"]["forced_evictions"]}
+
+
 def bench_serving_throughput(on_tpu: bool) -> dict:
     """Continuous batching + admission control on REAL TeacherServers
     (r23): the open-loop generator (`edl_tpu.distill.loadgen`) drives
@@ -2360,6 +2414,7 @@ def main() -> None:
             / p2p["elastic_downtime_p2p_s"], 2)
     scaler = bench_scaler(on_tpu)
     serving_slo = bench_serving_slo(on_tpu)
+    fleet = bench_fleet(on_tpu)
     serving_throughput = bench_serving_throughput(on_tpu)
     control_plane = bench_control_plane(on_tpu)
     store_ha = bench_store_ha(on_tpu)
@@ -2532,6 +2587,14 @@ def main() -> None:
             # ticks to restore the latency SLO after a 4x load step,
             # worst-trace attainment %, resizes paid (scaler/serving)
             **serving_slo,
+            # fleet-scale scheduling on the seeded FleetSim: preemptive
+            # gang fair-share vs plain fair-share on the spot-heavy
+            # trace (SLO attainment at equal-or-better goodput), and
+            # the 80%-spot goodput ratio with every preemption notice
+            # ridden as a scheduled seal-and-shrink (tools/
+            # fleet_bench.py runs the full policy x trace x ladder
+            # tournament)
+            **fleet,
             # teacher-pool serving tier under the open-loop generator:
             # window vs continuous batching p95 at equal sustained rps,
             # and per-class shed % under 2x overload with the delay-

@@ -32,6 +32,12 @@ def main(argv: list[str] | None = None) -> int:
                       help="disable chunk crc verification in workers: "
                            "the injected corruption must then be caught "
                            "by the AUDITOR (run exits nonzero)")
+    soak.add_argument("--weaken-preempt", action="store_true",
+                      help="workers IGNORE spot-preemption notices "
+                           "(EDL_TPU_SPOT_NOTICE_S=0): the hard kill "
+                           "then lands on unsealed progress and the "
+                           "auditor's I7 must catch it (run exits "
+                           "nonzero)")
     soak.add_argument("--mix", default=None,
                       help="comma-joined fault-class subset (default: "
                            "every class)")

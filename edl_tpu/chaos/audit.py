@@ -25,6 +25,11 @@ Invariants (doc/design_chaos.md maps each to its artifact):
       `reform_start` in a worker report pairs with a `reform_done`
       whose result is "in-place" or "stop-resume", unless the worker
       died mid-ladder — which is a process fault the respawn covers)
+  I7  a NOTICED spot preemption rides as a scheduled shrink: the
+      worker quiesce-seal-donates (`preempt_ready`) before the
+      deadline, the hard kill never lands before the deadline, and
+      the respawned incarnation restores a version >= the preempt
+      seal — no acked progress lost across a noticed preemption
 """
 
 from __future__ import annotations
@@ -350,6 +355,67 @@ class InvariantAuditor:
         rep.stats["reform_downgrades"] = downgrades
         rep.stats["reforms_died_midladder"] = died
 
+    # -- I7: noticed preemptions ride as scheduled seal-and-donate -----------
+
+    def _audit_preempts(self, rep: ChaosReport) -> None:
+        noticed = ridden = 0
+        for inj in self.injections:
+            if inj.get("fault") != "preempt":
+                continue
+            res = inj.get("resolution") or {}
+            if "skipped" in res:
+                continue
+            noticed += 1
+            slot = inj.get("slot")
+            records = self.worker_reports.get(f"pod{slot}", [])
+            wall = float(inj.get("wall", 0.0))
+            deadline = wall + float(inj.get("duration", 0.0))
+            kill = inj.get("kill_wall")
+            horizon = (kill if kill is not None else deadline) + 0.5
+            ready = [r for r in records
+                     if r.get("kind") == "preempt_ready"
+                     and wall <= r.get("ts", 0.0) <= horizon]
+            if not ready:
+                rep.breach(
+                    f"I7: pod{slot} hard-killed at t={inj.get('t')} "
+                    "with no preempt_ready — the spot notice was not "
+                    "honored (no quiesce-seal-donate before the "
+                    "deadline)")
+                continue
+            ok = True
+            if kill is not None and kill < deadline - 0.25:
+                ok = False
+                rep.breach(
+                    f"I7: pod{slot} killed {deadline - kill:.2f}s "
+                    "BEFORE the notice deadline — the window is a "
+                    "contract, not a suggestion")
+            # no acked progress lost: the respawned incarnation must
+            # restore a version >= the one sealed at the notice (the
+            # donated worker seals nothing afterwards, so that IS the
+            # newest acked state). I3 separately holds the digests.
+            seals = [int(r["version"]) for r in records
+                     if r.get("kind") == "seal"
+                     and r.get("ts", 0.0) <= ready[0]["ts"]]
+            restores = [int(r["version"]) for r in records
+                        if r.get("kind") == "restore"
+                        and r.get("ts", 0.0) > (kill or deadline)]
+            retired = "retired" in str(res.get("detail", ""))
+            if seals and not restores and not retired:
+                ok = False
+                rep.breach(
+                    f"I7: pod{slot} never restored after the "
+                    "preemption kill — the donated seal went unread")
+            elif seals and restores and max(restores) < max(seals):
+                ok = False
+                rep.breach(
+                    f"I7: pod{slot} restored ckpt-{max(restores)} < "
+                    f"the preempt seal ckpt-{max(seals)} — acked "
+                    "progress lost across a NOTICED preemption")
+            if ok:
+                ridden += 1
+        rep.stats["preempts_noticed"] = noticed
+        rep.stats["preempts_ridden"] = ridden
+
     def audit(self) -> ChaosReport:
         rep = ChaosReport()
         self._audit_probe(rep)
@@ -358,6 +424,7 @@ class InvariantAuditor:
         self._audit_drains(rep)
         self._audit_faults(rep)
         self._audit_reforms(rep)
+        self._audit_preempts(rep)
         typed = sum(1 for recs in self.worker_reports.values()
                     for r in recs if r.get("kind") == "typed_error")
         rep.stats["worker_typed_errors"] = typed

@@ -45,6 +45,10 @@ FAULT_CLASSES = (
                         # downstreams must resume by revision off the
                         # respawned relay (zero lost, zero duplicated —
                         # I1 runs through the relay-attached consumer)
+    "preempt",          # spot preemption NOTICE -> hard kill at the
+                        # deadline: the noticed worker must quiesce-
+                        # seal-donate before the kill (I7: no acked
+                        # progress lost, no kill before the deadline)
 )
 
 # Per-class weights for the tail of the schedule (the head cycles every
@@ -53,6 +57,7 @@ _WEIGHTS = {
     "wire": 4, "process-kill": 3, "process-pause": 2,
     "store-partition": 2, "leader-kill": 1, "ckpt-corrupt": 3,
     "resize": 2, "pool-resize": 2, "reform": 2, "relay": 1,
+    "preempt": 2,
 }
 
 
@@ -89,6 +94,12 @@ def _draw_event(rng: random.Random, fault: str, t: float, *,
                           duration=round(rng.uniform(1.0, 2.5), 3))
     if fault == "leader-kill":
         return FaultEvent(t, "leader-kill", "replica:leader")
+    if fault == "preempt":
+        # duration = the notice window: long enough for a live worker
+        # to quiesce-seal-donate (its loop polls the notice key every
+        # interval), short enough that riding it is a real deadline
+        return FaultEvent(t, "preempt", f"pod:{rng.randrange(pods)}",
+                          duration=round(rng.uniform(2.0, 3.0), 3))
     if fault == "relay":
         # duration = dead window before the respawn: long enough that
         # downstream watches hit the reconnect/backoff path, short

@@ -44,7 +44,7 @@ import time
 from edl_tpu.chaos import faults as fl
 from edl_tpu.chaos.audit import InvariantAuditor, load_worker_reports
 from edl_tpu.chaos.schedule import ChaosSchedule
-from edl_tpu.chaos.worker import marks_prefix, world_key
+from edl_tpu.chaos.worker import marks_prefix, preempt_key, world_key
 from edl_tpu.collective import register as reg
 from edl_tpu.collective.cluster import form_cluster
 from edl_tpu.collective.process import start_trainer, terminate_trainer
@@ -122,6 +122,10 @@ class Supervisor:
         with self._lock:
             ent = self._handles.get(slot)
             return ent[1] if ent else None
+
+    def entry(self, slot: int) -> tuple[str, object] | None:
+        with self._lock:
+            return self._handles.get(slot)
 
     def live_slots(self) -> dict[int, bool]:
         with self._lock:
@@ -393,6 +397,9 @@ class SoakWorld:
         self.injections: list[dict] = []
         self.pool_journal: list[dict] = []
         self._pending: list[tuple[float, str, object]] = []
+        self._noticed: set[str] = set()  # pod_ids with an outstanding
+        # spot notice: one notice per incarnation (a real spot plane
+        # coalesces repeats; the kill clears the entry)
         self._wire_active: fl.WireChaos | None = None
         self.max_downtime_s = 0.0
 
@@ -426,6 +433,8 @@ class SoakWorld:
         worker_env.setdefault("EDL_TPU_WIRE_STALL_S", "10")
         if self.args.weaken_checksums:
             worker_env["EDL_TPU_CKPT_VERIFY"] = "0"
+        if getattr(self.args, "weaken_preempt", False):
+            worker_env["EDL_TPU_SPOT_NOTICE_S"] = "0"
         self.report_dir = os.path.join(self.artifacts, "reports")
         self.ckpt_root = os.path.join(self.artifacts, "ckpt")
         os.makedirs(self.report_dir, exist_ok=True)
@@ -642,6 +651,35 @@ class SoakWorld:
                             self._pending.append(
                                 (time.monotonic() + event.duration,
                                  "sigcont", handle))
+            elif fault == "preempt":
+                # spot preemption: a NOTICE now, the hard kill exactly
+                # at the deadline (never before — I7 audits the order).
+                # The worker's contract is quiesce-seal-donate inside
+                # the window; --weaken-preempt turns that honoring off
+                # and the auditor must then catch the lost progress.
+                slot = int(event.target.split(":", 1)[1])
+                ent = self.supervisor.entry(slot)
+                if ent is None:
+                    rec["resolution"] = {"skipped": f"no pod at {slot}"}
+                    return
+                pod_id, proc = ent
+                if not proc.alive():
+                    rec["resolution"] = {"skipped":
+                                         f"pod{slot} dead at notice"}
+                    return
+                if pod_id in self._noticed:
+                    rec["resolution"] = {"skipped":
+                                         f"{pod_id} already noticed"}
+                    return
+                self._noticed.add(pod_id)
+                deadline = time.time() + event.duration
+                self.store.put(preempt_key(JOB, pod_id), json.dumps(
+                    {"deadline_unix": round(deadline, 3), "nodes": 1}))
+                rec["slot"] = slot
+                rec["pod_id"] = pod_id
+                self._pending.append(
+                    (time.monotonic() + event.duration, "preempt-kill",
+                     (proc, rec)))
             elif fault == "pool-resize":
                 delta = int(event.params.get("delta", 1))
                 cur = self.pool_journal[-1]["to"]
@@ -677,6 +715,14 @@ class SoakWorld:
                     self._respawn_replica(payload)
                 elif kind == "relay-respawn":
                     self._spawn_relay()
+                elif kind == "preempt-kill":
+                    proc, inj = payload
+                    if proc.alive():
+                        fl.ProcessChaos.sigkill(proc)
+                    # the audit holds kill_wall >= notice + window:
+                    # this stamp is the kill side of that contract
+                    inj["kill_wall"] = round(time.time(), 3)
+                    self._noticed.discard(inj.get("pod_id", ""))
             except Exception:  # noqa: BLE001 — retried at settle
                 log.exception("pending action %s failed", kind)
 
@@ -824,6 +870,12 @@ class SoakWorld:
                      "detail": f"alive={alive} cursor={cursor} "
                                f"at_inject="
                                f"{inj.get('relay_rev_at_inject')}"})
+            elif fault == "preempt":
+                # process-level recovery only: the respawned
+                # incarnation re-registers. Whether the NOTICE was
+                # honored (seal-donate before the deadline, nothing
+                # lost) is I7's job over the reports.
+                inj["resolution"] = self._resolve_respawn(inj, reports)
             elif fault == "pool-resize":
                 want = self.pool_journal[-1]["to"]
                 got = self.actuator.pool_size()

@@ -47,6 +47,7 @@ from edl_tpu.coord.collector import util_key
 from edl_tpu.obs import recorder as flight
 from edl_tpu.train import ckpt_io
 from edl_tpu.utils.backoff import Backoff
+from edl_tpu.utils.config import env_float
 from edl_tpu.utils.exceptions import EdlCheckpointCorrupt, EdlError
 from edl_tpu.utils.logging import get_logger
 
@@ -59,6 +60,15 @@ def marks_prefix(job_id: str) -> str:
 
 def world_key(job_id: str) -> str:
     return f"/{job_id}/world"
+
+
+def preempt_key(job_id: str, pod_id: str) -> str:
+    """The spot-preemption notice mailbox for ONE pod incarnation.
+
+    Keyed by pod_id (not slot) on purpose: the respawned incarnation
+    after the hard kill carries a fresh pod_id, so a stale notice can
+    never re-preempt the replacement."""
+    return f"/{job_id}/preempt/{pod_id}"
 
 
 class Reporter:
@@ -306,10 +316,19 @@ def run_worker(args) -> int:
     last_seal = time.monotonic()
     last_verify = time.monotonic()
     last_gen: int | None = None  # reform-ladder generation cursor
+    # spot-notice contract: >0 = a noticed preemption is honored as a
+    # scheduled quiesce-seal-donate before the kill deadline; 0 = the
+    # notice is IGNORED (the soak's --weaken-preempt negative control:
+    # the worker trains into the hard kill and the auditor's I7 must
+    # catch the lost progress)
+    notice_s = env_float("EDL_TPU_SPOT_NOTICE_S", 2.0)
+    preempted = False
     try:
         while not stop["flag"]:
             # -- membership: claim once, re-claim whenever the lease dies
-            if rank is None or register.lost.is_set():
+            if preempted:
+                pass  # donated: never re-claim; the deadline kill ends us
+            elif rank is None or register.lost.is_set():
                 if register.lost.is_set():
                     report("lease_lost", rank=rank)
                     register.release()
@@ -325,6 +344,36 @@ def run_worker(args) -> int:
                     if _sleep(backoff, stop):
                         break
                     continue
+            # -- spot notices: a noticed preemption is a SCHEDULED
+            # shrink, not a surprise. Quiesce (this loop is between
+            # steps by construction), seal so nothing acked is
+            # unsealed, then DONATE the rank so the survivors reform
+            # without us — and park until the deadline kill. The
+            # notice mailbox is keyed by pod_id, so only this
+            # incarnation can be preempted by it.
+            if notice_s > 0 and not preempted and rank is not None:
+                try:
+                    rec = store.get(preempt_key(args.job, args.pod_id))
+                except (EdlError, OSError):
+                    rec = None
+                if rec is not None:
+                    try:
+                        doc = json.loads(rec.value)
+                    except ValueError:
+                        doc = {}
+                    deadline = float(doc.get("deadline_unix",
+                                             time.time() + notice_s))
+                    report("preempt_notice",
+                           deadline_unix=round(deadline, 3))
+                    rig.seal()
+                    try:
+                        register.release()
+                    except (EdlError, OSError):
+                        pass
+                    rank = None
+                    preempted = True
+                    report("preempt_ready",
+                           margin_s=round(deadline - time.time(), 3))
             # -- the mark stream: resumable watch, resync on compaction
             if watch is None:
                 try:
@@ -377,6 +426,10 @@ def run_worker(args) -> int:
                                 max_nodes=args.max_nodes, ttl=args.ttl)
                             rank = None
             # -- utilization: what the autoscaler's collector digests
+            # (a donated pod publishes nothing: it is leaving the world)
+            if preempted:
+                time.sleep(args.interval)
+                continue
             try:
                 world, generation = _cluster_world(store, args.job)
                 rate = 50.0 * (world ** 0.7) if world else 0.0

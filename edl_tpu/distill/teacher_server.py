@@ -58,6 +58,7 @@ from edl_tpu.distill.admission import (PRIORITIES, AdmissionConfig,
                                        AdmissionQueue, AdmissionReject,
                                        normalize_priority)
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace
 from edl_tpu.utils.logging import get_logger
 
 log = get_logger("edl_tpu.distill.teacher_server")
@@ -609,17 +610,31 @@ class _Handler(socketserver.BaseRequestHandler):
                 except (tensor_wire.TensorWireError, OSError):
                     return
                 seq = meta.get("seq")
+                # the client's trace context rides meta["_tc"] (tensor
+                # wire attaches it); pop it even when tracing is off
+                # here so it never leaks into request handling
+                remote_ctx = trace.extract(meta)
                 if meta.get("op") == "predict":
                     if not tensors:
                         resp_q.put(("done", seq,
                                     {"ok": False,
                                      "error": "no feed tensors"}, {}))
                         continue
+                    tenant = meta.get("tenant", "default")
+                    prio = meta.get("priority", "normal")
+                    # the admission decision is the multi-tenant
+                    # attribution point: every accept/shed carries
+                    # (tenant, class) so a merged trace answers "whose
+                    # requests were shed during THAT pool resize"
+                    adm = trace.start_span(
+                        "serve.admit", parent=remote_ctx,
+                        attrs={"tenant": tenant, "class": prio})
                     try:
                         req = batcher.submit(
-                            tensors, tenant=meta.get("tenant", "default"),
-                            priority=meta.get("priority", "normal"))
+                            tensors, tenant=tenant, priority=prio)
                     except AdmissionReject as rej:
+                        if adm is not None:
+                            adm.end(admitted=False, reason=rej.reason)
                         # typed load-shed response on the SAME open
                         # connection — never a dropped socket: the
                         # client backs off retry_after_ms and retries
@@ -631,6 +646,8 @@ class _Handler(socketserver.BaseRequestHandler):
                                      "retry_after_ms": rej.retry_after_ms},
                                     {}))
                         continue
+                    if adm is not None:
+                        adm.end(admitted=True, rows=req.rows)
                     resp_q.put(("predict", seq, meta.get("compress"), req))
                 else:
                     try:

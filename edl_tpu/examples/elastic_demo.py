@@ -963,6 +963,198 @@ def run_reform_demo(args) -> int:
     return 0 if ok else 1
 
 
+def run_spot_demo(args) -> int:
+    """Spot-capacity riding end-to-end on one host: the live elastic
+    world (store + JobServer + launcher pods running THIS trainer)
+    receives a spot preemption NOTICE and must ride it as a SCHEDULED
+    quiesce-seal-donate shrink inside the notice window — never a
+    surprise kill, never lost progress. The window comes from
+    ``EDL_TPU_SPOT_NOTICE_S`` (a live CPU-jax world needs a generous
+    one; a real fleet gets 30-120s from its provider).
+
+    The script: bring the full world up with live donors (sealed
+    snapshots advertised), stamp the notice deadline, then issue the
+    scheduled shrink through /resize — exactly what the fleet
+    scheduler's preemptive policy does when a notice lands
+    (scaler/fleet_policy.py). Self-audits, exit 1 on any miss:
+
+      - the shrink COMPLETED before the deadline (world at the target
+        and a survivor's in-place adoption acked) — the notice was
+        ridden, so the provider's reclaim at the deadline finds the
+        capacity already donated and has nothing to kill;
+      - zero lost progress: the survivor adopted IN PLACE (same
+        process, in-memory state carried — mode "adopted", no respawn)
+        and nothing fell back to the disk recipe after the notice;
+      - the job still completes on the shrunk world.
+
+    Prints ``spot_summary=`` with the ride margin (deadline minus
+    completion) — the live counterpart of the fleet simulator's
+    ``notices_ridden`` column and the chaos soak's I7 invariant.
+    """
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    from edl_tpu.collective import migration as mig
+    from edl_tpu.collective import register as reg
+    from edl_tpu.collective.barrier import read_cluster
+    from edl_tpu.collective.job_server import (JobClient, JobServer,
+                                               JobState, request_resize)
+    from edl_tpu.coord.server import StoreServer
+    from edl_tpu.utils.config import env_float
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_NUM_CPU_DEVICES"] = "1"
+    os.environ.setdefault("EDL_TPU_BARRIER_STABLE", "0.5")
+    os.environ.setdefault("EDL_TPU_LEASE_TTL", "3.0")
+    os.environ["EDL_TPU_RESIZE_P2P"] = "1"
+
+    notice_s = env_float("EDL_TPU_SPOT_NOTICE_S", 60.0)
+    if notice_s <= 0:
+        log.error("spot demo: EDL_TPU_SPOT_NOTICE_S=0 means notices "
+                  "are ignored — nothing to demonstrate")
+        return 1
+    job_id = "spot_demo"
+    lo, hi = (int(x) for x in args.nodes_range.split(":"))
+    if hi < 2:
+        hi = 2
+    tmp = tempfile.mkdtemp(prefix="edl-spot-demo-")
+    srv = StoreServer(port=0, host="127.0.0.1", sweep_interval=0.2).start()
+    store_ep = f"127.0.0.1:{srv.port}"
+    state = JobState(job_id, lo, hi, desired=hi, store=srv.store)
+    server = JobServer(state, port=0).start()
+    epochs = max(args.epochs, 30)
+    steps = max(args.steps_per_epoch, 20)
+    step_time = args.step_time or 0.06
+    trainer_cmd = [
+        sys.executable, "-m", "edl_tpu.collective.launch",
+        "--store", store_ep, "--job-id", job_id,
+        "--nodes-range", f"{lo}:{hi}",
+        "--checkpoint-path", os.path.join(tmp, "ckpt"),
+        "--log-dir", os.path.join(tmp, "log"), "--",
+        sys.executable, "-m", "edl_tpu.examples.elastic_demo",
+        "--epochs", str(epochs), "--steps-per-epoch", str(steps),
+        "--batch", str(args.batch), "--step-time", str(step_time),
+        "--ckpt-steps", str(args.ckpt_steps or 10)]
+    client = JobClient(f"127.0.0.1:{server.port}", trainer_cmd, poll=0.5)
+    client_thread = threading.Thread(target=client.run, daemon=True,
+                                     name="spot-demo-jobclient")
+
+    acks: dict[tuple, dict] = {}   # (pod_id, ts) -> ack doc
+
+    def sample_acks() -> None:
+        records, _ = srv.store.get_prefix(mig.ack_prefix(job_id))
+        for rec in records:
+            try:
+                doc = json.loads(rec.value)
+                acks[(doc["pod_id"], doc["ts"])] = doc
+            except (ValueError, KeyError):
+                continue
+
+    def wait_for(pred, timeout, what) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            sample_acks()
+            if pred():
+                return True
+            time.sleep(0.25)
+        log.error("spot demo: timeout waiting for %s", what)
+        return False
+
+    def world() -> int:
+        c = read_cluster(srv.store, job_id)
+        return c.world_size if c is not None else 0
+
+    phases_ok = True
+    complete = False
+    t_notice = deadline = t_rode = None
+    try:
+        client_thread.start()
+        # Phase 1: full world with sealed snapshots advertised — the
+        # precondition for donating capacity without losing anything.
+        phases_ok &= wait_for(
+            lambda: world() == hi and mig.live_donors(srv.store, job_id),
+            args.p2p_timeout, "world up with live donors")
+        if phases_ok:
+            # Phase 2: the NOTICE. From here the world has notice_s
+            # seconds to quiesce-seal-donate down to the post-reclaim
+            # capacity; the scheduled shrink through /resize IS the
+            # riding maneuver (what PreemptiveFairSharePolicy issues
+            # when a notice lands in the fleet).
+            t_notice = time.time()
+            deadline = t_notice + notice_s
+            log.info("spot notice: %d node(s) reclaimed in %.0fs — "
+                     "scheduled shrink %d -> %d", hi - lo, notice_s,
+                     hi, lo)
+            request_resize(f"127.0.0.1:{server.port}", lo)
+
+            def rode() -> bool:
+                return world() == lo and any(
+                    d["mode"] == "adopted" and d["ts"] > t_notice
+                    for d in acks.values())
+
+            phases_ok &= wait_for(rode, notice_s,
+                                  "sealed shrink inside the notice "
+                                  "window")
+            t_rode = time.time()
+            if phases_ok and t_rode > deadline:
+                phases_ok = False
+                log.error("spot demo: shrink finished %.1fs AFTER the "
+                          "deadline — the provider's reclaim would "
+                          "have hard-killed live pods",
+                          t_rode - deadline)
+        if phases_ok:
+            complete = wait_for(
+                lambda: srv.store.get(reg.complete_key(job_id))
+                is not None,
+                args.p2p_timeout + epochs * steps * step_time,
+                "job completion on the shrunk world")
+        sample_acks()
+    finally:
+        client.stop()
+        client_thread.join(timeout=15)
+        for p in client.procs:  # belt and braces: no orphan launchers
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+        srv.stop()
+
+    adoptions = [d for d in acks.values() if d["mode"] == "adopted"
+                 and t_notice is not None and d["ts"] > t_notice]
+    disk_restores = [d for d in acks.values() if d["mode"] == "disk"
+                     and t_notice is not None and d["ts"] > t_notice]
+    gaps = [d["downtime_s"] for d in adoptions
+            if d.get("downtime_s") is not None]
+    rode_notice = (phases_ok and t_rode is not None
+                   and deadline is not None and t_rode <= deadline)
+    # zero lost progress = the survivors carried their in-memory state
+    # (in-place adoption, no respawn) and nothing degraded to the disk
+    # recipe after the notice; completion proves the world still trains
+    ok = (rode_notice and complete and len(adoptions) >= 1
+          and not disk_restores)
+    summary = {
+        "ok": ok, "complete": complete,
+        "rode_notice": rode_notice,
+        "notice_window_s": notice_s,
+        "ride_margin_s": round(deadline - t_rode, 3)
+        if rode_notice else None,
+        "adoptions_after_notice": len(adoptions),
+        "disk_restores_after_notice": len(disk_restores),
+        "spot_downtime_s": round(max(gaps), 4) if gaps else None,
+        "served_resizes": state.resize_log}
+    log.info("spot demo done: %s", summary)
+    if not ok:
+        log.error("spot audit failed: rode=%s adoptions=%d disk=%d "
+                  "complete=%s", rode_notice, len(adoptions),
+                  len(disk_restores), complete)
+    print("spot_summary=" + json.dumps(summary), flush=True)
+    shutil.rmtree(tmp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--epochs", type=int, default=5)
@@ -1019,6 +1211,14 @@ def main(argv=None) -> int:
                              "sized by the elastic world, scripted "
                              "shrink/grow, self-audited in-place "
                              "reforms with zero process restarts")
+    # spot-capacity riding demo (see run_spot_demo)
+    parser.add_argument("--spot", action="store_true",
+                        help="run the spot-riding loop: live world + "
+                             "preemption notice ridden as a scheduled "
+                             "quiesce-seal-donate shrink inside "
+                             "$EDL_TPU_SPOT_NOTICE_S; exit 1 unless "
+                             "it lands before the deadline with zero "
+                             "lost progress")
     parser.add_argument("--local-mesh-by-world", action="store_true",
                         help="trainer mode for --resize-reform: local "
                              "dp mesh sized by the elastic world, "
@@ -1026,10 +1226,12 @@ def main(argv=None) -> int:
                              "subdirs)")
     args = parser.parse_args(argv)
     if sum((args.scaler, args.resize_p2p, args.serve_scaler,
-            args.serve_load, args.resize_reform)) > 1:
+            args.serve_load, args.resize_reform, args.spot)) > 1:
         parser.error("--scaler, --serve-scaler, --serve-load, "
-                     "--resize-p2p and --resize-reform are separate "
-                     "demos")
+                     "--resize-p2p, --resize-reform and --spot are "
+                     "separate demos")
+    if args.spot:
+        return run_spot_demo(args)
     if args.serve_load:
         return run_serve_load_demo(args)
     if args.serve_scaler:

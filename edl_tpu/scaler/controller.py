@@ -271,8 +271,16 @@ class ScalerController:
         # (quantized to the tick interval, so it over- rather than
         # under-charges the amortization gate).
         self._downtime: dict[str, float] = {}
-        self._resize_pending: dict[str, tuple[float, int]] = {}
+        # the per-ACTION ladder the fleet simulator prices with
+        # (scaler/fleet.py DowntimeLadder): a shrink the survivors
+        # adopt in place is ~20x cheaper than a grow's reform, so one
+        # blended EWMA systematically over-charges shrinks. Keyed
+        # (job, kind); the blended EWMA above stays the fallback for
+        # kinds never yet measured.
+        self._downtime_kind: dict[tuple[str, str], float] = {}
+        self._resize_pending: dict[str, tuple[float, int, str]] = {}
         self._observed_downtime: dict[str, float] = {}  # this tick's
+        self._observed_kind: dict[str, str] = {}        # this tick's
         self._default_downtime = self.config.downtime_s
         if self.config.downtime_artifact:
             seeded = artifact_downtime(self.config.downtime_artifact)
@@ -350,8 +358,14 @@ class ScalerController:
             fresh_pods += 1
         fresh = bool(fresh_pods) and world > 0
         self._note_downtime(job_id, world, fresh, now)
+        # the view's downtime is the GROW price (a reform): that is the
+        # action the amortization gate actually guards, and the most
+        # expensive one — pricing shrinks with it only over-charges
+        downtime = self._downtime_kind.get(
+            (job_id, "reform"),
+            self._downtime.get(job_id, self._default_downtime))
         return JobView(job_id, world, throughput, lo, hi,
-                       self._downtime.get(job_id, self._default_downtime),
+                       downtime,
                        generation=job.get("generation"),
                        desired=desired,
                        fresh=fresh)
@@ -365,17 +379,22 @@ class ScalerController:
         pending = self._resize_pending.get(job_id)
         if pending is None or not fresh:
             return
-        ts, target = pending
+        ts, target, kind = pending
         if world != target:
             return
         measured = max(0.0, now - ts)
         prev = self._downtime.get(job_id)
         self._downtime[job_id] = (measured if prev is None
                                   else 0.5 * prev + 0.5 * measured)
+        kprev = self._downtime_kind.get((job_id, kind))
+        self._downtime_kind[(job_id, kind)] = (
+            measured if kprev is None else 0.5 * kprev + 0.5 * measured)
         self._observed_downtime[job_id] = measured
+        self._observed_kind[job_id] = kind
         del self._resize_pending[job_id]
-        log.info("measured elastic downtime for %s: %.2fs (ema %.2fs)",
-                 job_id, measured, self._downtime[job_id])
+        log.info("measured elastic downtime for %s: %.2fs (%s ema "
+                 "%.2fs)", job_id, measured, kind,
+                 self._downtime_kind[(job_id, kind)])
 
     def observe_service(self, service: str):
         """Digest one `Collector.service_rollup` into the serving
@@ -439,6 +458,12 @@ class ScalerController:
                     prev = self._downtime.get(job)
                     self._downtime[job] = (float(m) if prev is None
                                            else 0.5 * prev + 0.5 * float(m))
+                    kind = e.get("downtime_kind")
+                    if kind:
+                        kprev = self._downtime_kind.get((job, kind))
+                        self._downtime_kind[(job, kind)] = (
+                            float(m) if kprev is None
+                            else 0.5 * kprev + 0.5 * float(m))
             log.info("restored %d journal entries (scope %s)",
                      len(entries), self.scope)
         self._restored = True
@@ -494,8 +519,13 @@ class ScalerController:
                     self.policy.notify_resized(view.job_id, applied, now)
                     # arm the downtime probe (closed by _note_downtime
                     # on the first fresh record at the new world; a
-                    # follow-up resize re-arms it at the newer target)
-                    self._resize_pending[view.job_id] = (now, applied)
+                    # follow-up resize re-arms it at the newer target).
+                    # The kind matches the fleet ladder's taxonomy: a
+                    # shrink is an in-place adopt, a grow a reform.
+                    kind = ("adopt" if applied < prop.current
+                            else "reform")
+                    self._resize_pending[view.job_id] = (now, applied,
+                                                         kind)
                     log.info("resize %s: %d -> %d (%s)", view.job_id,
                              prop.current, applied, prop.reason)
                 except Exception as exc:  # noqa: BLE001 — journal it;
@@ -514,6 +544,7 @@ class ScalerController:
             "observed_downtime_s": (
                 round(self._observed_downtime.pop(view.job_id), 3)
                 if view.job_id in self._observed_downtime else None),
+            "downtime_kind": self._observed_kind.pop(view.job_id, None),
             "predicted_gain": (round(prop.predicted_gain, 3)
                                if prop.predicted_gain is not None
                                else None)})

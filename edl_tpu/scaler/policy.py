@@ -104,6 +104,10 @@ class ThroughputModel:
         self.ema = ema
         self._mean: dict[int, float] = {}
         self._count: dict[int, int] = {}
+        # cached log-log fit (c, a); None = stale. Fleet-scale planning
+        # (scaler/fleet.py) calls predict O(budget * jobs) times per
+        # decision, so the fit must not be recomputed per call.
+        self._fit: tuple[float, float] | None = None
 
     def observe(self, n: int, rate: float) -> None:
         if n < 1 or rate < 0:
@@ -113,6 +117,7 @@ class ThroughputModel:
         else:
             self._mean[n] = float(rate)
         self._count[n] = self._count.get(n, 0) + 1
+        self._fit = None
 
     def known(self) -> list[int]:
         return sorted(self._mean)
@@ -125,14 +130,18 @@ class ThroughputModel:
             return self._mean[n]
         pts = [(k, v) for k, v in self._mean.items() if v > 0]
         if len(pts) >= 2:
-            xs = [math.log(k) for k, _ in pts]
-            ys = [math.log(v) for _, v in pts]
-            mx, my = sum(xs) / len(xs), sum(ys) / len(ys)
-            denom = sum((x - mx) ** 2 for x in xs)
-            a = (sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / denom
-                 if denom > 0 else 0.0)
-            a = max(0.0, min(a, 1.2))
-            return math.exp(my - a * mx) * n ** a
+            if self._fit is None:
+                xs = [math.log(k) for k, _ in pts]
+                ys = [math.log(v) for _, v in pts]
+                mx, my = sum(xs) / len(xs), sum(ys) / len(ys)
+                denom = sum((x - mx) ** 2 for x in xs)
+                a = (sum((x - mx) * (y - my)
+                         for x, y in zip(xs, ys)) / denom
+                     if denom > 0 else 0.0)
+                a = max(0.0, min(a, 1.2))
+                self._fit = (math.exp(my - a * mx), a)
+            c, a = self._fit
+            return c * n ** a
         if len(pts) == 1:
             k, v = pts[0]
             return v * n / k
@@ -373,10 +382,12 @@ class FairSharePolicy(_PolicyBase):
                 continue
             delta = desired - v.effective_desired
             if delta > 0:
-                model = self.model(job)
-                t0, t1 = model.predict(cur), model.predict(desired)
-                gain = (t1 - t0) if t0 is not None and t1 is not None \
-                    else None
+                gain = None
+                if cur >= 1:  # a suspended world predicts nothing
+                    model = self.model(job)
+                    t0, t1 = model.predict(cur), model.predict(desired)
+                    gain = (t1 - t0) if t0 is not None and t1 is not None \
+                        else None
                 if gain is not None and gain <= 0:
                     proposals[job] = Proposal(job, cur, cur,
                                               "no-marginal-gain", gain)
@@ -478,7 +489,7 @@ class FairSharePolicy(_PolicyBase):
             delta = desired - v.effective_desired
             if delta > 0:
                 gain = None
-                if kind == "trainer":
+                if kind == "trainer" and cur >= 1:
                     model = self.model(rid)
                     t0, t1 = model.predict(cur), model.predict(desired)
                     gain = (t1 - t0) if t0 is not None and t1 is not None \

@@ -62,6 +62,7 @@ from typing import Callable, Protocol, runtime_checkable
 
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs import recorder as flight
+from edl_tpu.obs import trace
 from edl_tpu.scaler.policy import Proposal
 from edl_tpu.utils.config import field
 from edl_tpu.utils.logging import get_logger
@@ -453,6 +454,15 @@ class TeacherPoolActuator:
         return self.resize(desired)
 
     def resize(self, desired: int) -> dict:
+        # a real span (not instant): it parents onto scaler.decide when
+        # the controller drove it, and its [t0, t0+dur) window is what a
+        # merged trace intersects the per-request serve.admit spans with
+        # to attribute shed (tenant, class) traffic to THIS resize
+        with trace.span("serve.resize", attrs={"service": self.service,
+                                               "requested": desired}):
+            return self._resize_locked_protocol(desired)
+
+    def _resize_locked_protocol(self, desired: int) -> dict:
         requested = desired
         with self._lock:
             desired = max(self.min_teachers,

@@ -180,6 +180,18 @@ ENV_VARS: dict[str, str] = {
                              "delay-based shed rule",
     "EDL_TPU_SERVE_RETRY_BUDGET": "reader-side bounded retry budget per "
                                   "task on teacher shed responses",
+    # -- fleet simulator / preemptive scheduler ----------------------------
+    "EDL_TPU_FLEET_JOBS": "fleet tournament: concurrent trainer jobs "
+                          "per generated trace",
+    "EDL_TPU_FLEET_POOLS": "fleet tournament: concurrent serving pools "
+                           "per generated trace",
+    "EDL_TPU_FLEET_TICKS": "fleet tournament: virtual ticks per run",
+    "EDL_TPU_FLEET_SPOT_FRACTION": "fleet tournament: fraction of the "
+                                   "node budget that is revocable spot "
+                                   "capacity",
+    "EDL_TPU_SPOT_NOTICE_S": "spot preemption notice window seconds a "
+                             "noticed worker has to quiesce-seal-donate "
+                             "before the hard kill (0 = ignore notices)",
     # -- analysis plane -----------------------------------------------------
     "EDL_TPU_LOCKGRAPH": "lock-order race detector during pytest (1 = on)",
     "EDL_TPU_LOCKGRAPH_OUT": "lockgraph JSON report path",

@@ -395,6 +395,104 @@ def test_audit_reform_unknown_result_is_a_breach():
     assert any("I6" in b and "unknown result" in b for b in rep.breaches)
 
 
+def _preempt_injection(**over):
+    inj = {"t": 3.0, "fault": "preempt", "target": "pod:0", "slot": 0,
+           "duration": 2.5, "wall": 100.0, "kill_wall": 102.5,
+           "pod_id": "pod0-0", "resolution": {"recovered": True}}
+    inj.update(over)
+    return inj
+
+
+def test_audit_preempt_ridden_is_ok():
+    # notice at wall=100, deadline 102.5: the worker seals ckpt-3,
+    # reports preempt_ready inside the window, dies at the deadline,
+    # and the respawned incarnation restores ckpt-3 — I7 rides
+    reports = {"pod0": [
+        {"kind": "seal", "version": 3, "digest": "d3", "ts": 100.4},
+        {"kind": "preempt_ready", "margin_s": 2.0, "ts": 100.5},
+        {"kind": "started", "pod_id": "pod0-1", "ts": 103.0},
+        {"kind": "restore", "version": 3, "digest": "d3", "ts": 103.2},
+    ]}
+    rep = _auditor(injections=[_preempt_injection()],
+                   worker_reports=reports).audit()
+    assert rep.ok, rep.breaches
+    assert rep.stats["preempts_noticed"] == 1
+    assert rep.stats["preempts_ridden"] == 1
+
+
+def test_audit_preempt_unhonored_notice_is_breach():
+    # hard kill landed with no preempt_ready in the window: the
+    # worker ignored the notice (the --weaken-preempt control)
+    reports = {"pod0": [
+        {"kind": "seal", "version": 3, "digest": "d3", "ts": 100.4},
+        {"kind": "started", "pod_id": "pod0-1", "ts": 103.0},
+        {"kind": "restore", "version": 3, "digest": "d3", "ts": 103.2},
+    ]}
+    rep = _auditor(injections=[_preempt_injection()],
+                   worker_reports=reports).audit()
+    assert any("I7" in b and "not honored" in b for b in rep.breaches), \
+        rep.breaches
+    assert rep.stats["preempts_ridden"] == 0
+
+
+def test_audit_preempt_early_kill_is_breach():
+    # killed 2s before the 2.5s deadline: the window is a contract
+    reports = {"pod0": [
+        {"kind": "seal", "version": 3, "digest": "d3", "ts": 100.3},
+        {"kind": "preempt_ready", "margin_s": 2.1, "ts": 100.4},
+        {"kind": "restore", "version": 3, "digest": "d3", "ts": 101.5},
+    ]}
+    rep = _auditor(injections=[_preempt_injection(kill_wall=100.5)],
+                   worker_reports=reports).audit()
+    assert any("I7" in b and "BEFORE the notice deadline" in b
+               for b in rep.breaches), rep.breaches
+
+
+def test_audit_preempt_lost_progress_is_breach():
+    # the respawn restored ckpt-2 < the preempt seal ckpt-3: acked
+    # progress lost across a NOTICED preemption
+    reports = {"pod0": [
+        {"kind": "seal", "version": 3, "digest": "d3", "ts": 100.3},
+        {"kind": "preempt_ready", "margin_s": 2.1, "ts": 100.4},
+        {"kind": "started", "pod_id": "pod0-1", "ts": 103.0},
+        {"kind": "restore", "version": 2, "digest": "d2", "ts": 103.2},
+    ]}
+    rep = _auditor(injections=[_preempt_injection()],
+                   worker_reports=reports).audit()
+    assert any("I7" in b and "acked" in b for b in rep.breaches), \
+        rep.breaches
+    # ...and a donated seal that nobody ever read is equally a breach
+    reports2 = {"pod0": [
+        {"kind": "seal", "version": 3, "digest": "d3", "ts": 100.3},
+        {"kind": "preempt_ready", "margin_s": 2.1, "ts": 100.4},
+    ]}
+    rep2 = _auditor(injections=[_preempt_injection()],
+                    worker_reports=reports2).audit()
+    assert any("I7" in b and "unread" in b for b in rep2.breaches), \
+        rep2.breaches
+
+
+def test_audit_preempt_skipped_and_retired_are_not_breaches():
+    # a notice skipped by the injector (dead pod / already noticed)
+    # is not audited; a pod retired by a shrink after donating needs
+    # no restore — its seal was adopted by the survivors
+    rep = _auditor(injections=[_preempt_injection(
+        resolution={"skipped": "pod0 dead at notice"})]).audit()
+    assert rep.ok, rep.breaches
+    assert rep.stats["preempts_noticed"] == 0
+    reports = {"pod0": [
+        {"kind": "seal", "version": 3, "digest": "d3", "ts": 100.3},
+        {"kind": "preempt_ready", "margin_s": 2.1, "ts": 100.4},
+    ]}
+    rep2 = _auditor(
+        injections=[_preempt_injection(
+            resolution={"recovered": True,
+                        "detail": "slot retired by resize"})],
+        worker_reports=reports).audit()
+    assert rep2.ok, rep2.breaches
+    assert rep2.stats["preempts_ridden"] == 1
+
+
 def test_audit_branch_anomalies_pinned_to_zero():
     # commit-gated fan-out (r20) turned the documented r18 stat into a
     # hard invariant: any observed uncommitted suffix fails the soak

@@ -1,0 +1,199 @@
+"""Fleet-scale scheduler plane: simulator, preemptive fair share, spot.
+
+The tournament contract (doc/design_scaler.md, fleet section) is
+seed-exact: every number in a tournament table is a pure function of
+(trace seed, ladder, policy) — no wall clocks, no unseeded RNGs; the
+sim-determinism lint row holds the transitive import closure to that.
+These tests pin the contract (sha256 of a fixed tournament), the gang
+and capacity invariants, the revocation pass, and the spot-notice
+riding that the live `preempt` chaos class drills end-to-end.
+"""
+
+import json
+
+import pytest
+
+from edl_tpu.scaler.fleet import (LEGACY, MEASURED, DowntimeLadder,
+                                  FleetSim, FleetTrace, run_fleet,
+                                  tournament)
+from edl_tpu.scaler.fleet_policy import (GreedyRebalancePolicy,
+                                         PreemptiveFairSharePolicy,
+                                         default_policies)
+from edl_tpu.scaler.policy import FairSharePolicy
+
+KW = dict(cooldown_s=15.0, horizon_s=60.0)
+SMALL = dict(n_jobs=24, n_pools=5, ticks=100)
+
+# The replay contract, pinned: this exact (trace, ladders, policies)
+# grid hashed to this table when the test was written. Any diff means
+# a sim or policy behavior change — rev it DELIBERATELY, with the
+# change that moved it called out in the commit.
+PINNED_GRID_FP = \
+    "25ca3a601c0cec424613ed3cb2bdf4cf15b578873c1354b22ef61eee81d1a0b3"
+
+
+def _pinned_tournament():
+    return tournament(
+        traces=[FleetTrace.generate("pin", 11, spot_fraction=0.25,
+                                    **SMALL)],
+        ladders=[MEASURED, LEGACY],
+        policies={"fair": lambda: FairSharePolicy(1, **KW),
+                  "preemptive":
+                      lambda: PreemptiveFairSharePolicy(1, **KW)})
+
+
+def test_tournament_fingerprint_is_pinned():
+    assert _pinned_tournament()["fingerprint"] == PINNED_GRID_FP
+
+
+def test_tournament_same_seed_identical_tables():
+    t1, t2 = _pinned_tournament(), _pinned_tournament()
+    assert t1["fingerprint"] == t2["fingerprint"]
+    assert t1["rows"] == t2["rows"]
+
+
+def _job_key(spec):
+    # curves hold lambdas (not value-comparable); the curve NAME plus
+    # the scheduling facts is the seed-exact surface
+    return (spec.job_id, spec.curve.name, spec.tier, spec.gang,
+            spec.min_nodes, spec.max_nodes, spec.arrive_tick,
+            spec.depart_tick, spec.noise)
+
+
+def test_trace_generation_is_seed_exact_and_seed_sensitive():
+    a = FleetTrace.generate("t", 3, **SMALL)
+    b = FleetTrace.generate("t", 3, **SMALL)
+    c = FleetTrace.generate("t", 4, **SMALL)
+    assert [_job_key(j) for j in a.jobs] == [_job_key(j) for j in b.jobs]
+    assert [(p.service, p.tenant, p.slo_p95_ms, p.arrive_tick)
+            for p in a.pools] == \
+           [(p.service, p.tenant, p.slo_p95_ms, p.arrive_tick)
+            for p in b.pools]
+    assert a.preemptions == b.preemptions
+    assert [_job_key(j) for j in a.jobs] != [_job_key(j) for j in c.jobs]
+
+
+def test_gang_legal_allocations_throughout():
+    trace = FleetTrace.generate("gang", 5, **SMALL)
+    sim = FleetSim(trace)
+    run_fleet(sim, PreemptiveFairSharePolicy(sim.capacity(), **KW))
+    for job in sim.jobs.values():
+        nodes = job.sim.nodes
+        assert nodes == 0 or (nodes % job.spec.gang == 0
+                              and nodes >= job.spec.min_nodes), \
+            f"{job.spec.job_id}: {nodes} nodes vs gang {job.spec.gang}"
+
+
+def test_force_evict_enforces_capacity_and_bills_lost_rows():
+    # capacity enforcement is trainer-side: pools are the protected
+    # tier and are never force-evicted, so the guarantee after every
+    # enforcement pass is allocated <= capacity OR no trainer holds a
+    # node (the pool tier alone can exceed a collapsed capacity)
+    trace = FleetTrace.generate("cap", 6, **SMALL)
+    sim = FleetSim(trace)
+    for _ in range(10):
+        sim.tick()
+    assert sim.allocated() > 0
+    sim._capacity = max(0, sim.allocated() - 5)
+    sim._force_evict()
+    trainer_nodes = sum(j.sim.nodes for j in sim.jobs.values())
+    assert sim.allocated() <= sim.capacity() or trainer_nodes == 0
+    assert sim.forced_evictions > 0
+    # a forced eviction is a HARD stop: stop-resume downtime was
+    # billed and the victims' unsealed rows are gone
+    assert sim.downtime_paid_s >= sim.ladder.stop_resume_s
+    assert sim.resizes_by_kind["stop-resume"] == sim.forced_evictions
+    assert sim.lost_rows > 0
+    # every surviving allocation is still gang-legal after eviction
+    for job in sim.jobs.values():
+        nodes = job.sim.nodes
+        assert nodes == 0 or (nodes % job.spec.gang == 0
+                              and nodes >= job.spec.min_nodes)
+
+
+def test_revocation_pass_fires_and_is_tier_ordered():
+    # a surging fleet: serving pools breach, the preemptive policy
+    # must revoke from batch-tier trainers (never online tier first)
+    trace = FleetTrace.generate("surge", 7, **SMALL)
+    policy = PreemptiveFairSharePolicy(1, **KW)
+    run_fleet(FleetSim(trace), policy)
+    stats = policy.stats()
+    assert stats["revocations"] > 0
+    tiers = {r.get("tier", "batch") for r in policy.revocations
+             if r["for"] == "slo"}
+    # SLO-relief revocations come from the preemptible tiers, lowest
+    # first — never the prod tier (capacity enforcement at a spot
+    # deadline is the only pass allowed to touch anyone)
+    assert tiers <= {"best-effort", "batch"}, tiers
+
+
+def test_preemptive_beats_fair_share_on_slo_at_goodput():
+    trace = FleetTrace.generate("surge", 7, **SMALL)
+    base = run_fleet(FleetSim(trace), FairSharePolicy(1, **KW))
+    pre = run_fleet(FleetSim(trace),
+                    PreemptiveFairSharePolicy(1, **KW))
+    assert pre["slo_attainment"] >= base["slo_attainment"]
+    assert pre["goodput_rows_per_s"] >= 0.98 * base["goodput_rows_per_s"]
+
+
+def test_spot_notice_riding_vs_blind_baseline():
+    spot = FleetTrace.generate("spot", 9, spot_fraction=0.5, **SMALL)
+    blind = run_fleet(FleetSim(spot), FairSharePolicy(1, **KW))
+    aware = run_fleet(FleetSim(spot),
+                      PreemptiveFairSharePolicy(1, **KW))
+    assert blind["forced_evictions"] > 0
+    assert aware["forced_evictions"] < blind["forced_evictions"]
+    assert aware["notices_ridden"] > blind["notices_ridden"]
+    assert aware["lost_rows"] <= blind["lost_rows"]
+
+
+def test_ladder_classify_and_costs():
+    assert MEASURED.classify(4, 2) == "adopt"
+    assert MEASURED.classify(2, 4) == "reform"
+    assert MEASURED.cost("adopt") < MEASURED.cost("reform") \
+        < MEASURED.cost("stop-resume")
+    # legacy prices every action like a stop-resume
+    assert LEGACY.cost("adopt") == LEGACY.cost("reform") \
+        == LEGACY.cost("stop-resume")
+
+
+def test_ladder_from_artifact(tmp_path):
+    art = tmp_path / "bench.json"
+    art.write_text(json.dumps({"extras": {
+        "elastic_downtime_p2p_s": 0.05,
+        "elastic_downtime_multihost_s": 0.2,
+        "elastic_downtime_s": 1.5}}))
+    ladder = DowntimeLadder.from_artifact(str(art))
+    assert ladder is not None
+    assert ladder.cost("adopt") == pytest.approx(0.05)
+    assert ladder.cost("reform") == pytest.approx(0.2)
+    assert ladder.cost("stop-resume") == pytest.approx(1.5)
+    assert DowntimeLadder.from_artifact(str(tmp_path / "no")) is None
+
+
+def test_cheap_ladder_flips_a_policy_race():
+    # the point of pricing per action: under legacy costs the greedy
+    # rebalancer's constant reshuffling is ruinous; under measured
+    # costs it competes — the ladder must be able to change a winner
+    policies = default_policies()
+    assert {"fair-share", "preemptive-fair-share",
+            "greedy-rebalance"} <= set(policies)
+    trace = FleetTrace.generate("noisy", 16, noise=0.25, **SMALL)
+    greedy_m = run_fleet(FleetSim(trace, ladder=MEASURED),
+                         GreedyRebalancePolicy(1, **KW))
+    greedy_l = run_fleet(FleetSim(trace, ladder=LEGACY),
+                         GreedyRebalancePolicy(1, **KW))
+    assert greedy_l["downtime_paid_s"] > greedy_m["downtime_paid_s"]
+
+
+def test_metrics_shape_and_notice_accounting():
+    spot = FleetTrace.generate("spot", 9, spot_fraction=0.5, **SMALL)
+    out = run_fleet(FleetSim(spot),
+                    PreemptiveFairSharePolicy(1, **KW))
+    for key in ("goodput_rows_per_s", "jain_fairness", "slo_attainment",
+                "downtime_paid_s", "forced_evictions", "notices_issued",
+                "notices_ridden", "lost_rows", "spot_fraction"):
+        assert key in out, key
+    assert 0.0 < out["jain_fairness"] <= 1.0
+    assert 0.0 <= out["slo_attainment"] <= 1.0
+    assert out["notices_ridden"] <= out["notices_issued"]

@@ -1,0 +1,211 @@
+"""Fleet-scale policy tournament: policy x trace x ladder grids.
+
+Runs the seeded `FleetSim` tournament (hundreds of concurrent trainer
+jobs + serving pools per trace) and prints ONE markdown table per
+ladder: fleet goodput (sealed trainer rows + SLO-ok served rows per
+second), Jain fairness over entitlement-normalized occupancy, SLO
+attainment, the downtime bill by action kind, and the spot columns
+(forced evictions, notices ridden, progress lost). Two extra seeded
+experiments follow the grid:
+
+* spot riding — the same trace shape at 0% and 80% revocable
+  capacity under `PreemptiveFairSharePolicy`; the ratio is the price
+  of living on spot when every notice is ridden as a scheduled shrink;
+* the ladder flip — the ``noisy`` trace, where raw-observation
+  re-packing (`GreedyRebalancePolicy`) beats fair-share under the
+  measured reform ladder and loses under legacy stop-resume pricing.
+
+`--check` turns the run into a gate (nonzero exit unless the
+tournament's headline claims hold); `--json` writes the full artifact
+(`FLEET_r20.json`). Deterministic end to end: same seeds => identical
+tables and sha256 fingerprint.
+
+  python tools/fleet_bench.py --check --json FLEET_r20.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python tools/fleet_bench.py` puts tools/
+    sys.path.insert(0, REPO)  # on sys.path, not the repo root
+
+
+def print_tables(rows) -> None:
+    ladders = []
+    for r in rows:
+        if r["ladder"] not in ladders:
+            ladders.append(r["ladder"])
+    for ladder in ladders:
+        print(f"\n### ladder: {ladder}")
+        print("| trace | policy | goodput rows/s | SLO attain "
+              "| Jain | downtime s | adopt/reform/stop | evict "
+              "| rode | lost rows |")
+        print("|---|---|---|---|---|---|---|---|---|---|")
+        for r in rows:
+            if r["ladder"] != ladder:
+                continue
+            k = r["resizes_by_kind"]
+            print(f"| {r['trace']} | {r['policy']} "
+                  f"| {r['goodput_rows_per_s']} "
+                  f"| {r['slo_attainment']:.4f} "
+                  f"| {r['jain_fairness']:.3f} "
+                  f"| {r['downtime_paid_s']} "
+                  f"| {k['adopt']}/{k['reform']}/{k['stop-resume']} "
+                  f"| {r['forced_evictions']} "
+                  f"| {r['notices_ridden']}/{r['notices_issued']} "
+                  f"| {r['lost_rows']} |")
+
+
+def spot_experiment(args) -> dict:
+    """The same trace shape all-reserved vs 80% revocable, ridden by
+    the preemptive policy."""
+    from edl_tpu.scaler.fleet import FleetSim, FleetTrace, run_fleet
+    from edl_tpu.scaler.fleet_policy import PreemptiveFairSharePolicy
+    out = {}
+    for key, frac in (("reserved", 0.0), ("spot80", 0.8)):
+        trace = FleetTrace.generate(
+            "spot-ride", 21, n_jobs=args.jobs, n_pools=args.pools,
+            ticks=args.ticks, spot_fraction=frac)
+        out[key] = run_fleet(
+            FleetSim(trace),
+            PreemptiveFairSharePolicy(1, cooldown_s=15.0,
+                                      horizon_s=60.0))
+    out["goodput_ratio"] = round(
+        out["spot80"]["goodput_rows_per_s"]
+        / out["reserved"]["goodput_rows_per_s"], 4)
+    return out
+
+
+def check(rows, spot) -> list[str]:
+    """The headline claims the artifact must support."""
+    failures = []
+    cell = {(r["trace"], r["ladder"], r["policy"]): r for r in rows}
+    traces = sorted({r["trace"] for r in rows})
+    # 1. preemptive beats fair-share on SLO attainment at
+    # equal-or-better goodput, per trace, under the measured ladder
+    wins = 0
+    for t in traces:
+        base = cell.get((t, "measured", "fair-share"))
+        pre = cell.get((t, "measured", "preemptive-fair-share"))
+        if base is None or pre is None:
+            continue
+        if pre["slo_attainment"] >= base["slo_attainment"] \
+                and pre["goodput_rows_per_s"] >= base["goodput_rows_per_s"] \
+                and (pre["slo_attainment"] > base["slo_attainment"]
+                     or pre["goodput_rows_per_s"]
+                     > base["goodput_rows_per_s"]):
+            wins += 1
+    if wins < 3:
+        failures.append(f"preemptive-beats-fair on {wins} traces (<3)")
+    # 2. 80% revocable capacity sustains >=90% of all-reserved goodput
+    # with zero forced evictions (every notice ridden)
+    if spot["goodput_ratio"] < 0.9:
+        failures.append(f"spot80 goodput ratio {spot['goodput_ratio']}"
+                        " < 0.9")
+    if spot["spot80"]["forced_evictions"] \
+            > spot["reserved"]["forced_evictions"]:
+        failures.append("spot80 paid forced evictions "
+                        f"({spot['spot80']['forced_evictions']})")
+    # 3. the ladder changes the winner: greedy re-packing beats
+    # fair-share on the noisy trace under measured, loses under legacy
+    for ladder, want_greedy in (("measured", True), ("legacy", False)):
+        fair = cell.get(("noisy", ladder, "fair-share"))
+        greedy = cell.get(("noisy", ladder, "greedy-rebalance"))
+        if fair is None or greedy is None:
+            continue
+        greedy_wins = (greedy["goodput_rows_per_s"]
+                       > fair["goodput_rows_per_s"])
+        if greedy_wins != want_greedy:
+            failures.append(
+                f"noisy/{ladder}: greedy "
+                f"{'should' if want_greedy else 'should not'} win "
+                f"({greedy['goodput_rows_per_s']} vs "
+                f"{fair['goodput_rows_per_s']})")
+    return failures
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tools/fleet_bench.py")
+    parser.add_argument("--jobs", type=int, default=180)
+    parser.add_argument("--pools", type=int, default=24)
+    parser.add_argument("--ticks", type=int, default=240)
+    parser.add_argument("--decide-every", type=int, default=2)
+    parser.add_argument("--ladder", metavar="BENCH_JSON", default=None,
+                        help="bench artifact whose measured extras "
+                             "(elastic_downtime_p2p_s / _multihost_s / "
+                             "elastic_downtime_s) price the resize "
+                             "ladder instead of the built-in defaults")
+    parser.add_argument("--json", metavar="PATH", default=None,
+                        help="write the full tournament artifact here")
+    parser.add_argument("--check", action="store_true",
+                        help="exit nonzero unless the headline claims "
+                             "hold (acceptance gate)")
+    args = parser.parse_args(argv)
+
+    from edl_tpu.scaler.fleet import (LEGACY, DowntimeLadder,
+                                      tournament, trace_menu)
+    ladders = None
+    if args.ladder:
+        measured = DowntimeLadder.from_artifact(args.ladder)
+        if measured is None:
+            print(f"unreadable ladder artifact: {args.ladder}",
+                  file=sys.stderr)
+            return 2
+        # keep the canonical grid names so --check applies unchanged
+        measured = DowntimeLadder("measured", measured.adopt_s,
+                                  measured.reform_s,
+                                  measured.stop_resume_s)
+        ladders = [measured, LEGACY]
+
+    traces = trace_menu(n_jobs=args.jobs, n_pools=args.pools,
+                        ticks=args.ticks)
+    n_workloads = args.jobs + args.pools
+    print(f"fleet tournament: {len(traces)} traces x "
+          f"{n_workloads} concurrent workloads x {args.ticks} ticks "
+          f"(goodput = sealed trainer rows + SLO-ok served rows; a "
+          f"row served during a breach is throughput, not goodput)")
+    result = tournament(traces=traces, ladders=ladders,
+                        decide_every=args.decide_every)
+    print_tables(result["rows"])
+
+    print("\n### spot riding (preemptive policy)")
+    spot = spot_experiment(args)
+    for key in ("reserved", "spot80"):
+        r = spot[key]
+        print(f"{key}: goodput={r['goodput_rows_per_s']} "
+              f"evict={r['forced_evictions']} "
+              f"rode={r['notices_ridden']}/{r['notices_issued']} "
+              f"lost={r['lost_rows']}")
+    print(f"spot80/reserved goodput ratio: {spot['goodput_ratio']}")
+    print(f"\nfingerprint: {result['fingerprint']}")
+
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump({"rows": result["rows"],
+                       "fingerprint": result["fingerprint"],
+                       "spot": spot,
+                       "config": {"jobs": args.jobs,
+                                  "pools": args.pools,
+                                  "ticks": args.ticks,
+                                  "decide_every": args.decide_every}},
+                      f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.json}")
+
+    if args.check:
+        failures = check(result["rows"], spot)
+        for f in failures:
+            print(f"CHECK FAIL: {f}", file=sys.stderr)
+        if failures:
+            return 1
+        print("all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

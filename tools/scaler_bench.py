@@ -84,7 +84,26 @@ def main(argv=None) -> int:
     parser.add_argument("--budget", type=int, default=10,
                         help="fairshare: shared node budget")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--ladder", default=None, metavar="BENCH_JSON",
+                        help="price resizes from a bench.py artifact's "
+                             "measured downtime extras instead of "
+                             "--downtime-s (shared with serve_bench "
+                             "and fleet_bench: one artifact, one "
+                             "price list)")
     args = parser.parse_args(argv)
+
+    if args.ladder:
+        from edl_tpu.scaler.fleet import DowntimeLadder
+        ladder = DowntimeLadder.from_artifact(args.ladder)
+        if ladder is None:
+            print(f"unreadable ladder artifact: {args.ladder}",
+                  file=sys.stderr)
+            return 2
+        # SimCluster charges ONE price per resize; a scheduled resize
+        # is a reform (the grow direction — shrinks ride the cheaper
+        # adopt path that this single-knob sim cannot split out)
+        args.downtime_s = ladder.reform_s
+        print(f"ladder={ladder.name}: downtime_s={args.downtime_s}")
 
     print(f"ticks={args.ticks} tick={args.tick_s:.0f}s "
           f"downtime={args.downtime_s}s cooldown={args.cooldown:.0f}s "

@@ -61,9 +61,30 @@ def main(argv=None) -> int:
     parser.add_argument("--noise", type=float, default=0.01)
     parser.add_argument("--max-teachers", type=int, default=16)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--spawn-delay-ticks", type=int, default=2,
+                        help="ticks before a grown teacher is ready")
+    parser.add_argument("--ladder", default=None, metavar="BENCH_JSON",
+                        help="derive the spawn delay from a bench.py "
+                             "artifact's measured stop-resume downtime "
+                             "(a teacher spawn is a cold start; shared "
+                             "with scaler_bench and fleet_bench)")
     args = parser.parse_args(argv)
 
     from edl_tpu.scaler.simulator import SimServingPool, run_serving_policy
+
+    if args.ladder:
+        import math
+
+        from edl_tpu.scaler.fleet import DowntimeLadder
+        ladder = DowntimeLadder.from_artifact(args.ladder)
+        if ladder is None:
+            print(f"unreadable ladder artifact: {args.ladder}",
+                  file=sys.stderr)
+            return 2
+        args.spawn_delay_ticks = max(
+            1, math.ceil(ladder.stop_resume_s / args.tick_s))
+        print(f"ladder={ladder.name}: spawn_delay_ticks="
+              f"{args.spawn_delay_ticks}")
 
     print(f"ticks={args.ticks} tick={args.tick_s:g}s "
           f"slo={args.slo_p95_ms:g}ms teacher_rate={args.teacher_rate:g} "
@@ -79,6 +100,7 @@ def main(argv=None) -> int:
                 "svc", trace, teacher_rate=args.teacher_rate,
                 slo_p95_ms=args.slo_p95_ms, teachers=1,
                 max_teachers=args.max_teachers, tick_s=args.tick_s,
+                spawn_delay_ticks=args.spawn_delay_ticks,
                 noise=args.noise, seed=args.seed)
             out = run_serving_policy(pool, make_policy(),
                                      ticks=args.ticks, settle_ticks=50)
